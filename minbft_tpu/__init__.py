@@ -1,0 +1,23 @@
+"""minbft_tpu — a TPU-native BFT consensus framework.
+
+A from-scratch rebuild of the capabilities of MinBFT (reference:
+hyperledger-labs/minbft, a Go + SGX-C implementation) designed TPU-first:
+
+- The per-message cryptographic verification hot path (client signatures,
+  USIG UI certificates on PREPARE/COMMIT) is a **batched, data-parallel XLA
+  kernel** (``minbft_tpu.ops``) dispatched through an asyncio batching engine
+  (``minbft_tpu.parallel.engine``) instead of serial per-message CPU crypto.
+- The protocol engine (``minbft_tpu.core``) is an asyncio re-design of the
+  reference's goroutine/closure graph (reference core/message-handling.go),
+  restructured so validation awaits one batched verify result per quorum
+  instead of n serial verifies (reference core/commit.go:108-143).
+- The trusted component (USIG) keeps the reference enclave's semantics
+  (monotonic counter, epoch, increment-after-sign; reference
+  usig/sgx/enclave/usig.c:36-76) with a C++ native implementation
+  (``minbft_tpu/native``) plus a TPU batch verifier for UI certificates.
+
+Layering mirrors the reference (SURVEY.md §1): messages / api / core /
+client / sample, with the TPU compute stack in ops/parallel/models.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,50 @@
+"""Protocol messages: typed classes, canonical codec, authen bytes.
+
+Mirrors the reference ``messages`` package (reference messages/api.go,
+messages/authen.go, messages/protobuf/) — see module docstrings.
+"""
+
+from .authen import authen_bytes, authen_digest
+from .codec import CodecError, marshal, unmarshal
+from .message import (
+    CERTIFIED_MESSAGES,
+    CLIENT_MESSAGES,
+    PEER_MESSAGES,
+    REPLICA_MESSAGES,
+    SIGNED_MESSAGES,
+    UI,
+    Commit,
+    Hello,
+    Message,
+    Prepare,
+    ReqViewChange,
+    Reply,
+    Request,
+    is_client_message,
+    is_peer_message,
+)
+from .utils import stringify
+
+__all__ = [
+    "UI",
+    "Message",
+    "Hello",
+    "Request",
+    "Reply",
+    "Prepare",
+    "Commit",
+    "ReqViewChange",
+    "CLIENT_MESSAGES",
+    "REPLICA_MESSAGES",
+    "PEER_MESSAGES",
+    "CERTIFIED_MESSAGES",
+    "SIGNED_MESSAGES",
+    "is_client_message",
+    "is_peer_message",
+    "marshal",
+    "unmarshal",
+    "CodecError",
+    "authen_bytes",
+    "authen_digest",
+    "stringify",
+]

@@ -1,0 +1,85 @@
+"""Canonical authentication bytes.
+
+Mirrors reference messages/authen.go:27-82: for each signable/certifiable
+message kind, a canonical byte string over which its signature or USIG UI is
+computed — a tag string, big-endian fixed-width fields, and SHA-256 digests of
+variable-length payloads.
+
+Key structural properties preserved from the reference:
+
+- A PREPARE's authen bytes cover the embedded REQUEST (including the client's
+  signature), so a UI on a PREPARE transitively authenticates the exact
+  request bytes being ordered.
+- A COMMIT's authen bytes include the **primary's UI counter**
+  (reference messages/authen.go:70), binding the commitment to the exact slot
+  the primary assigned.
+- A message's own signature/UI is never part of its own authen bytes.
+
+The 32-byte :func:`authen_digest` of these bytes is the unit of work shipped
+to the TPU batch verifiers: every scheme in :mod:`minbft_tpu.ops` operates on
+fixed-width digests so batch shapes stay static under ``jit``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+from . import codec
+from .message import Commit, Message, Prepare, ReqViewChange, Reply, Request
+
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def authen_bytes(m: Message) -> bytes:
+    """Canonical bytes a signature / UI certificate for ``m`` covers
+    (reference messages/authen.go:27-82)."""
+    if isinstance(m, Request):
+        return (
+            b"REQUEST"
+            + _U32.pack(m.client_id)
+            + _U64.pack(m.seq)
+            + _sha256(m.operation)
+        )
+    if isinstance(m, Reply):
+        return (
+            b"REPLY"
+            + _U32.pack(m.replica_id)
+            + _U32.pack(m.client_id)
+            + _U64.pack(m.seq)
+            + _sha256(m.result)
+        )
+    if isinstance(m, Prepare):
+        # Covers the embedded request *with* its client signature, so the
+        # primary's UI authenticates the exact bytes it ordered.
+        return (
+            b"PREPARE"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.view)
+            + _sha256(codec.marshal(m.request))
+        )
+    if isinstance(m, Commit):
+        if m.prepare.ui is None:
+            raise ValueError("COMMIT authen bytes require the primary's UI")
+        # Binds the commitment to the prepare's content AND the primary's
+        # USIG counter value (reference messages/authen.go:70).
+        return (
+            b"COMMIT"
+            + _U32.pack(m.replica_id)
+            + _sha256(authen_bytes(m.prepare))
+            + _U64.pack(m.prepare.ui.counter)
+        )
+    if isinstance(m, ReqViewChange):
+        return b"REQ-VIEW-CHANGE" + _U32.pack(m.replica_id) + _U64.pack(m.new_view)
+    raise TypeError(f"{type(m).__name__} has no authen bytes")
+
+
+def authen_digest(m: Message) -> bytes:
+    """SHA-256 of :func:`authen_bytes` — the fixed-width unit shipped to the
+    TPU batch verifiers."""
+    return _sha256(authen_bytes(m))

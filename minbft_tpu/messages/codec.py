@@ -1,0 +1,206 @@
+"""Deterministic flat binary codec for protocol messages.
+
+Replaces the reference's protobuf wire format (reference
+messages/protobuf/pb/messages.proto:24-33, one ``Message`` wrapper with a
+``oneof typed``) with a canonical hand-rolled layout:
+
+    byte 0          kind tag
+    then fields     big-endian fixed-width ints; bytes fields length-prefixed
+                    with u32; embedded messages as length-prefixed marshalled
+                    bytes.
+
+Determinism is load-bearing: USIG certificates and signatures cover digests
+of these exact bytes (see :mod:`minbft_tpu.messages.authen`), and protobuf
+does not guarantee canonical serialization.  A flat codec is also much
+cheaper to encode/decode on the host, which keeps the Python side of the
+pipeline off the critical path while the TPU does the crypto.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+from .message import (
+    UI,
+    Commit,
+    Hello,
+    Message,
+    Prepare,
+    ReqViewChange,
+    Reply,
+    Request,
+)
+
+# Kind tags (wire stable).
+_TAG_HELLO = 0x01
+_TAG_REQUEST = 0x02
+_TAG_REPLY = 0x03
+_TAG_PREPARE = 0x04
+_TAG_COMMIT = 0x05
+_TAG_REQ_VIEW_CHANGE = 0x06
+
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+
+
+class CodecError(ValueError):
+    pass
+
+
+def _pack_u32(v: int) -> bytes:
+    if not 0 <= v < 2**32:
+        raise CodecError(f"u32 field out of range: {v}")
+    return _U32.pack(v)
+
+
+def _pack_u64(v: int) -> bytes:
+    if not 0 <= v < 2**64:
+        raise CodecError(f"u64 field out of range: {v}")
+    return _U64.pack(v)
+
+
+def _pack_bytes(b: bytes) -> bytes:
+    return _U32.pack(len(b)) + b
+
+
+def _read_bytes(data: bytes, off: int) -> Tuple[bytes, int]:
+    if off + 4 > len(data):
+        raise CodecError("truncated length prefix")
+    (n,) = _U32.unpack_from(data, off)
+    off += 4
+    if off + n > len(data):
+        raise CodecError("truncated bytes field")
+    return data[off : off + n], off + n
+
+
+def _read_u32(data: bytes, off: int) -> Tuple[int, int]:
+    if off + 4 > len(data):
+        raise CodecError("truncated u32")
+    return _U32.unpack_from(data, off)[0], off + 4
+
+
+def _read_u64(data: bytes, off: int) -> Tuple[int, int]:
+    if off + 8 > len(data):
+        raise CodecError("truncated u64")
+    return _U64.unpack_from(data, off)[0], off + 8
+
+
+def _pack_ui(ui) -> bytes:
+    return _pack_bytes(ui.to_bytes() if ui is not None else b"")
+
+
+def _parse_ui(uib: bytes):
+    if not uib:
+        return None
+    try:
+        return UI.from_bytes(uib)
+    except ValueError as e:
+        raise CodecError(f"malformed UI: {e}") from e
+
+
+def marshal(m: Message) -> bytes:
+    """Serialize a message to canonical bytes
+    (reference messages/protobuf/impl.go:87-107 equivalent)."""
+    if isinstance(m, Hello):
+        return bytes([_TAG_HELLO]) + _pack_u32(m.replica_id)
+    if isinstance(m, Request):
+        return (
+            bytes([_TAG_REQUEST])
+            + _pack_u32(m.client_id)
+            + _pack_u64(m.seq)
+            + _pack_bytes(m.operation)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, Reply):
+        return (
+            bytes([_TAG_REPLY])
+            + _pack_u32(m.replica_id)
+            + _pack_u32(m.client_id)
+            + _pack_u64(m.seq)
+            + _pack_bytes(m.result)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, Prepare):
+        return (
+            bytes([_TAG_PREPARE])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.view)
+            + _pack_bytes(marshal(m.request))
+            + _pack_ui(m.ui)
+        )
+    if isinstance(m, Commit):
+        return (
+            bytes([_TAG_COMMIT])
+            + _pack_u32(m.replica_id)
+            + _pack_bytes(marshal(m.prepare))
+            + _pack_ui(m.ui)
+        )
+    if isinstance(m, ReqViewChange):
+        return (
+            bytes([_TAG_REQ_VIEW_CHANGE])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.new_view)
+            + _pack_bytes(m.signature)
+        )
+    raise CodecError(f"unknown message type {type(m)!r}")
+
+
+def unmarshal(data: bytes) -> Message:
+    """Parse canonical bytes back into a typed message
+    (reference messages.MessageImpl.NewFromBinary, messages/api.go:26)."""
+    m, off = _unmarshal_at(data, 0)
+    if off != len(data):
+        raise CodecError("trailing bytes after message")
+    return m
+
+
+def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
+    if off >= len(data):
+        raise CodecError("empty message")
+    tag = data[off]
+    off += 1
+    if tag == _TAG_HELLO:
+        rid, off = _read_u32(data, off)
+        return Hello(replica_id=rid), off
+    if tag == _TAG_REQUEST:
+        cid, off = _read_u32(data, off)
+        seq, off = _read_u64(data, off)
+        op, off = _read_bytes(data, off)
+        sig, off = _read_bytes(data, off)
+        return Request(client_id=cid, seq=seq, operation=op, signature=sig), off
+    if tag == _TAG_REPLY:
+        rid, off = _read_u32(data, off)
+        cid, off = _read_u32(data, off)
+        seq, off = _read_u64(data, off)
+        result, off = _read_bytes(data, off)
+        sig, off = _read_bytes(data, off)
+        return (
+            Reply(replica_id=rid, client_id=cid, seq=seq, result=result, signature=sig),
+            off,
+        )
+    if tag == _TAG_PREPARE:
+        rid, off = _read_u32(data, off)
+        view, off = _read_u64(data, off)
+        reqb, off = _read_bytes(data, off)
+        uib, off = _read_bytes(data, off)
+        req = unmarshal(reqb)
+        if not isinstance(req, Request):
+            raise CodecError("PREPARE must embed a REQUEST")
+        ui = _parse_ui(uib)
+        return Prepare(replica_id=rid, view=view, request=req, ui=ui), off
+    if tag == _TAG_COMMIT:
+        rid, off = _read_u32(data, off)
+        prepb, off = _read_bytes(data, off)
+        uib, off = _read_bytes(data, off)
+        prep = unmarshal(prepb)
+        if not isinstance(prep, Prepare):
+            raise CodecError("COMMIT must embed a PREPARE")
+        ui = _parse_ui(uib)
+        return Commit(replica_id=rid, prepare=prep, ui=ui), off
+    if tag == _TAG_REQ_VIEW_CHANGE:
+        rid, off = _read_u32(data, off)
+        nv, off = _read_u64(data, off)
+        sig, off = _read_bytes(data, off)
+        return ReqViewChange(replica_id=rid, new_view=nv, signature=sig), off
+    raise CodecError(f"unknown message tag {tag:#x}")

@@ -1,0 +1,144 @@
+"""Typed protocol messages.
+
+Mirrors the abstract message hierarchy of the reference
+(reference messages/api.go:35-118): Message → {ClientMessage, ReplicaMessage,
+PeerMessage, CertifiedMessage, SignedMessage} → six concrete kinds.
+
+Embedding structure is preserved exactly: a COMMIT embeds the full PREPARE it
+commits to, and a PREPARE embeds the full REQUEST it orders
+(reference messages/api.go:88-101).  That embedding is what lets a backup
+re-validate everything it acts on without extra round trips.
+
+Unlike the reference's protobuf implementation, serialization here is a flat,
+deterministic, hand-rolled binary codec (:mod:`minbft_tpu.messages.codec`) —
+there is no schema compiler in the loop and byte layouts are canonical, which
+matters because signatures and USIG certificates are computed over
+:func:`minbft_tpu.messages.authen.authen_bytes` of these exact bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class UI:
+    """Unique Identifier produced by a USIG.
+
+    Mirrors reference usig/usig.go:44-51: a monotonic counter value plus a
+    certificate binding (message digest, epoch, counter) under the replica's
+    trusted key.  Marshalled big-endian (reference usig/usig.go:84-102).
+    """
+
+    counter: int
+    cert: bytes = b""
+
+    def to_bytes(self) -> bytes:
+        return self.counter.to_bytes(8, "big") + self.cert
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "UI":
+        if len(data) < 8:
+            raise ValueError("UI too short")
+        return cls(counter=int.from_bytes(data[:8], "big"), cert=data[8:])
+
+
+class Message:
+    """Base for all protocol messages."""
+
+    KIND: str = "?"
+
+    def to_bytes(self) -> bytes:
+        from . import codec
+
+        return codec.marshal(self)
+
+
+@dataclasses.dataclass
+class Hello(Message):
+    """Peer handshake announcing the sender's replica ID.
+
+    Sent once when a replica opens a peer connection; the receiver responds by
+    streaming its broadcast + unicast-to-that-peer message logs
+    (reference core/message-handling.go:269-290, 316-350).
+    """
+
+    KIND = "HELLO"
+    replica_id: int
+
+
+@dataclasses.dataclass
+class Request(Message):
+    """Client request: (client, seq, operation), signed by the client
+    (reference messages/api.go:47-56)."""
+
+    KIND = "REQUEST"
+    client_id: int
+    seq: int
+    operation: bytes
+    signature: bytes = b""
+
+
+@dataclasses.dataclass
+class Reply(Message):
+    """Replica's signed reply to a client (reference messages/api.go:75-86)."""
+
+    KIND = "REPLY"
+    replica_id: int
+    client_id: int
+    seq: int
+    result: bytes
+    signature: bytes = b""
+
+
+@dataclasses.dataclass
+class Prepare(Message):
+    """Primary's ordering proposal for one request, certified by the
+    primary's USIG (reference messages/api.go:58-65)."""
+
+    KIND = "PREPARE"
+    replica_id: int
+    view: int
+    request: Request
+    ui: Optional[UI] = None
+
+
+@dataclasses.dataclass
+class Commit(Message):
+    """Backup's commitment to a PREPARE; embeds the full PREPARE and is
+    certified by the backup's USIG (reference messages/api.go:67-73)."""
+
+    KIND = "COMMIT"
+    replica_id: int
+    prepare: Prepare
+    ui: Optional[UI] = None
+
+
+@dataclasses.dataclass
+class ReqViewChange(Message):
+    """Signed request to move to a new view
+    (reference messages/api.go:103-110)."""
+
+    KIND = "REQ-VIEW-CHANGE"
+    replica_id: int
+    new_view: int
+    signature: bytes = b""
+
+
+# ---------------------------------------------------------------------------
+# Classification helpers (reference messages/api.go interface hierarchy).
+
+CLIENT_MESSAGES = (Request,)
+REPLICA_MESSAGES = (Reply, Prepare, Commit, ReqViewChange)
+PEER_MESSAGES = (Prepare, Commit, ReqViewChange)
+CERTIFIED_MESSAGES = (Prepare, Commit)  # carry a USIG UI
+SIGNED_MESSAGES = (Request, Reply, ReqViewChange)  # carry a plain signature
+
+
+def is_peer_message(m: Message) -> bool:
+    return isinstance(m, PEER_MESSAGES)
+
+
+def is_client_message(m: Message) -> bool:
+    return isinstance(m, CLIENT_MESSAGES)

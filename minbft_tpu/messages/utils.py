@@ -1,0 +1,32 @@
+"""Diagnostic message rendering (reference messages/utils.go:25-63)."""
+
+from __future__ import annotations
+
+from .message import Commit, Hello, Message, Prepare, ReqViewChange, Reply, Request
+
+
+def stringify(m: Message) -> str:
+    if isinstance(m, Hello):
+        return f"<HELLO replica={m.replica_id}>"
+    if isinstance(m, Request):
+        return f"<REQUEST client={m.client_id} seq={m.seq} op={len(m.operation)}B>"
+    if isinstance(m, Reply):
+        return (
+            f"<REPLY replica={m.replica_id} client={m.client_id} "
+            f"seq={m.seq} result={len(m.result)}B>"
+        )
+    if isinstance(m, Prepare):
+        cv = m.ui.counter if m.ui else None
+        return (
+            f"<PREPARE cv={cv} replica={m.replica_id} view={m.view} "
+            f"request={stringify(m.request)}>"
+        )
+    if isinstance(m, Commit):
+        cv = m.ui.counter if m.ui else None
+        return (
+            f"<COMMIT cv={cv} replica={m.replica_id} "
+            f"prepare={stringify(m.prepare)}>"
+        )
+    if isinstance(m, ReqViewChange):
+        return f"<REQ-VIEW-CHANGE replica={m.replica_id} new_view={m.new_view}>"
+    return f"<{type(m).__name__}>"

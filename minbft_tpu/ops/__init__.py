@@ -1,0 +1,13 @@
+"""TPU crypto kernels: the data-parallel compute path of the framework.
+
+Every scheme operates on fixed-width inputs (32-byte digests, fixed-width
+keys/signatures) so batch shapes stay static under ``jit``:
+
+- :mod:`minbft_tpu.ops.sha256` — SHA-256 compression in uint32 jax.numpy.
+- :mod:`minbft_tpu.ops.hmac_sha256` — batched HMAC-SHA256 (symmetric USIG
+  certificates and MAC authenticator).
+- :mod:`minbft_tpu.ops.limbs` — 256-bit modular arithmetic as 16×16-bit limb
+  vectors (Montgomery), the substrate for the public-key schemes.
+- :mod:`minbft_tpu.ops.p256` — batched ECDSA-P256 verification.
+- :mod:`minbft_tpu.ops.ed25519` — batched Ed25519 verification.
+"""

@@ -1,0 +1,67 @@
+"""Batched HMAC-SHA256 over fixed 32-byte inputs.
+
+This is the symmetric authentication scheme of the build (the SGX-less USIG
+certificate scheme of BASELINE config[0] and the MAC scheme the reference
+lists as future work, reference README.md:499-500).  Everything is fixed
+shape: key = 32 bytes, message = a 32-byte authen digest
+(:func:`minbft_tpu.messages.authen_digest`), so one HMAC is exactly four
+SHA-256 compressions and a batch of B HMACs is one ``vmap``-ped kernel.
+
+Layout (RFC 2104 with B=64-byte block):
+    inner = H( (key ⊕ ipad) ‖ msg32 ‖ pad )   — 2 compressions
+    mac   = H( (key ⊕ opad) ‖ inner ‖ pad )   — 2 compressions
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sha256 import IV, compress
+
+_IPAD = np.uint32(0x36363636)
+_OPAD = np.uint32(0x5C5C5C5C)
+
+# Padding tail for a 64+32-byte message: 0x80 then zeros then bitlen=768.
+_TAIL = np.array([0x80000000, 0, 0, 0, 0, 0, 0, 768], dtype=np.uint32)
+
+
+def hmac32(key: jnp.ndarray, msg: jnp.ndarray) -> jnp.ndarray:
+    """HMAC-SHA256(key32, msg32): key [8] u32, msg [8] u32 → mac [8] u32."""
+    key = key.astype(jnp.uint32)
+    msg = msg.astype(jnp.uint32)
+    tail = jnp.asarray(_TAIL)
+    zeros8 = jnp.zeros(8, dtype=jnp.uint32)
+
+    ipad_block = jnp.concatenate([key ^ _IPAD, zeros8 ^ _IPAD])
+    opad_block = jnp.concatenate([key ^ _OPAD, zeros8 ^ _OPAD])
+
+    inner_state = compress(jnp.asarray(IV), ipad_block)
+    inner = compress(inner_state, jnp.concatenate([msg, tail]))
+
+    outer_state = compress(jnp.asarray(IV), opad_block)
+    return compress(outer_state, jnp.concatenate([inner, tail]))
+
+
+def hmac32_verify(key: jnp.ndarray, msg: jnp.ndarray, mac: jnp.ndarray) -> jnp.ndarray:
+    """→ bool scalar: does HMAC(key, msg) equal ``mac``?"""
+    return jnp.all(hmac32(key, msg) == mac.astype(jnp.uint32))
+
+
+# Batched: keys [B,8], msgs [B,8], macs [B,8] → [B] bool.
+hmac32_batch = jax.vmap(hmac32)
+hmac32_verify_batch = jax.vmap(hmac32_verify)
+
+
+@jax.jit
+def hmac_verify_kernel(keys, msgs, macs):
+    """The jitted batch-verify entry point used by the verification engine."""
+    return hmac32_verify_batch(keys, msgs, macs)
+
+
+@jax.jit
+def hmac_sign_kernel(keys, msgs):
+    """Batched MAC generation (used by the software USIG and tests)."""
+    return hmac32_batch(keys, msgs)

@@ -1,0 +1,143 @@
+"""SHA-256 as a JAX/XLA kernel.
+
+The reference performs all hashing/signing serially on the CPU (Go
+crypto/sha256 inside usig/sgx/sgx-usig.go:52-62 and
+sample/authentication/crypto.go:103-126).  Here the compression function is
+expressed in pure ``uint32`` jax.numpy ops so it can be ``vmap``-ped over a
+batch axis and fused by XLA onto the TPU VPU: thousands of independent
+HMAC/UI-certificate checks become one data-parallel kernel launch
+(see :mod:`minbft_tpu.ops.hmac_sha256` and
+:mod:`minbft_tpu.parallel.engine`).
+
+Design notes (TPU-first):
+- All shapes are static.  The protocol layer hashes variable-length message
+  bytes down to 32-byte digests on the host
+  (:func:`minbft_tpu.messages.authen_digest`); every on-device hash input is
+  a fixed number of 64-byte blocks, so there is exactly one compiled kernel
+  per (batch-bucket, block-count) pair.
+- The 64-round loop runs as ``lax.fori_loop`` with the message schedule
+  computed on the fly from a rolling 16-word window — small XLA graph, no
+  64×-unrolled HLO, and no dynamically indexed 64-entry buffer.
+- Scalar-shaped core + ``jax.vmap`` = the batch dimension maps onto VPU
+  lanes; nothing here prevents further sharding of the batch axis across a
+  device mesh (see :mod:`minbft_tpu.parallel.mesh`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Round constants (FIPS 180-4 §4.2.2).
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+IV = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression: ``state`` [8] uint32, ``block`` [16] uint32
+    (big-endian words) → new state [8] uint32.
+
+    Scalar-shaped; batch via ``jax.vmap``.
+    """
+    k = jnp.asarray(_K)
+
+    def round_body(t, carry):
+        a, b, c, d, e, f, g, h, w = carry
+        # w is the rolling 16-word schedule window; w[0] == W[t].
+        wt = w[0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k[t] + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        # Extend the schedule: W[t+16] from the current window.
+        sig0 = _rotr(w[1], 7) ^ _rotr(w[1], 18) ^ (w[1] >> np.uint32(3))
+        sig1 = _rotr(w[14], 17) ^ _rotr(w[14], 19) ^ (w[14] >> np.uint32(10))
+        w_next = w[0] + sig0 + w[9] + sig1
+        w = jnp.concatenate([w[1:], w_next[None]])
+        return (t1 + t2, a, b, c, d + t1, e, f, g, w)
+
+    init = tuple(state[i] for i in range(8)) + (block.astype(jnp.uint32),)
+    a, b, c, d, e, f, g, h, _ = lax.fori_loop(0, 64, round_body, init)
+    return state + jnp.stack([a, b, c, d, e, f, g, h])
+
+
+def sha256_fixed(blocks: jnp.ndarray) -> jnp.ndarray:
+    """SHA-256 over a fixed number of pre-padded blocks.
+
+    ``blocks``: [nblocks, 16] uint32 → digest [8] uint32.  ``nblocks`` is a
+    static (trace-time) constant, so this unrolls to a short chain of
+    compressions — ideal for the fixed-layout inputs used by the protocol.
+    """
+    state = jnp.asarray(IV)
+    for i in range(blocks.shape[0]):
+        state = compress(state, blocks[i])
+    return state
+
+
+# Batched variants.
+compress_batch = jax.vmap(compress)
+sha256_fixed_batch = jax.vmap(sha256_fixed)
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers (numpy) for padding and byte/word conversion.
+
+
+def pad_message(data: bytes) -> np.ndarray:
+    """FIPS 180-4 padding → [nblocks, 16] uint32 big-endian words."""
+    bitlen = len(data) * 8
+    data = data + b"\x80"
+    data += b"\x00" * ((56 - len(data)) % 64)
+    data += bitlen.to_bytes(8, "big")
+    words = np.frombuffer(data, dtype=">u4").astype(np.uint32)
+    return words.reshape(-1, 16)
+
+
+def words_to_bytes(words: np.ndarray) -> bytes:
+    """uint32 big-endian words → bytes."""
+    return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()
+
+
+def bytes_to_words(data: bytes) -> np.ndarray:
+    """bytes (multiple of 4) → uint32 big-endian words."""
+    if len(data) % 4:
+        raise ValueError("length must be a multiple of 4")
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32)
+
+
+def sha256_host(data: bytes) -> bytes:
+    """Full SHA-256 of arbitrary bytes through the JAX kernel (used for
+    differential testing against hashlib)."""
+    digest = sha256_fixed(jnp.asarray(pad_message(data)))
+    return words_to_bytes(np.asarray(digest))

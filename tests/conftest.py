@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Tests run on the CPU JAX backend with 8 virtual devices — the "SIM mode" of
+this build (the reference's analogue is running the SGX enclave in simulation
+mode, reference usig/sgx/Makefile SGX_MODE=SIM): CI needs no TPU, while the
+sharding/collective code paths still execute against a real 8-device mesh.
+
+Must set env vars before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,170 @@
+"""Messages layer tests.
+
+Mirrors the reference's protobuf round-trip tests
+(reference messages/protobuf/*_test.go) plus authen-bytes invariants
+(reference messages/authen.go).
+"""
+
+import pytest
+
+from minbft_tpu import messages as msgs
+
+
+def _sample_request(sig=b"\x01\x02"):
+    return msgs.Request(client_id=3, seq=42, operation=b"op-bytes", signature=sig)
+
+
+def _sample_prepare():
+    return msgs.Prepare(
+        replica_id=0,
+        view=7,
+        request=_sample_request(),
+        ui=msgs.UI(counter=5, cert=b"cert!"),
+    )
+
+
+def _sample_commit():
+    return msgs.Commit(replica_id=2, prepare=_sample_prepare(), ui=msgs.UI(9, b"c2"))
+
+
+@pytest.mark.parametrize(
+    "m",
+    [
+        msgs.Hello(replica_id=4),
+        _sample_request(),
+        msgs.Request(client_id=0, seq=0, operation=b"", signature=b""),
+        msgs.Reply(replica_id=1, client_id=3, seq=42, result=b"res", signature=b"s"),
+        _sample_prepare(),
+        msgs.Prepare(replica_id=1, view=0, request=_sample_request(b""), ui=None),
+        _sample_commit(),
+        msgs.ReqViewChange(replica_id=1, new_view=2, signature=b"sig"),
+    ],
+)
+def test_roundtrip(m):
+    data = msgs.marshal(m)
+    out = msgs.unmarshal(data)
+    assert out == m
+    assert msgs.marshal(out) == data
+
+
+def test_roundtrip_preserves_embedding():
+    c = msgs.unmarshal(msgs.marshal(_sample_commit()))
+    assert isinstance(c, msgs.Commit)
+    assert isinstance(c.prepare, msgs.Prepare)
+    assert isinstance(c.prepare.request, msgs.Request)
+    assert c.prepare.request.operation == b"op-bytes"
+    assert c.prepare.ui.counter == 5
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        b"",
+        b"\xff",
+        b"\x02\x00\x00\x00\x03",  # truncated request
+        msgs.marshal(msgs.Hello(1)) + b"junk",  # trailing bytes
+    ],
+)
+def test_unmarshal_rejects_malformed(data):
+    with pytest.raises(msgs.CodecError):
+        msgs.unmarshal(data)
+
+
+def test_commit_must_embed_prepare():
+    # Hand-craft a COMMIT embedding a REQUEST instead of a PREPARE.
+    import struct
+
+    inner = msgs.marshal(_sample_request())
+    data = bytes([0x05]) + struct.pack(">I", 1) + struct.pack(">I", len(inner)) + inner
+    data += struct.pack(">I", 0)
+    with pytest.raises(msgs.CodecError):
+        msgs.unmarshal(data)
+
+
+def test_authen_bytes_deterministic_and_distinct():
+    seen = set()
+    for m in [
+        _sample_request(),
+        msgs.Reply(replica_id=1, client_id=3, seq=42, result=b"res"),
+        _sample_prepare(),
+        _sample_commit(),
+        msgs.ReqViewChange(replica_id=1, new_view=2),
+    ]:
+        ab = msgs.authen_bytes(m)
+        assert ab == msgs.authen_bytes(m)  # deterministic
+        assert ab not in seen  # distinct across kinds
+        seen.add(ab)
+        assert len(msgs.authen_digest(m)) == 32
+
+
+def test_authen_bytes_excludes_own_signature():
+    # A message's own signature must not be covered by its authen bytes
+    # (the signature is computed over them).
+    r1 = _sample_request(sig=b"aaa")
+    r2 = _sample_request(sig=b"bbb")
+    assert msgs.authen_bytes(r1) == msgs.authen_bytes(r2)
+
+
+def test_prepare_authen_covers_request_signature():
+    # But a PREPARE's authen bytes DO cover the embedded request's signature
+    # (the primary certifies the exact bytes it ordered).
+    p1 = msgs.Prepare(replica_id=0, view=1, request=_sample_request(b"aaa"))
+    p2 = msgs.Prepare(replica_id=0, view=1, request=_sample_request(b"bbb"))
+    assert msgs.authen_bytes(p1) != msgs.authen_bytes(p2)
+
+
+def test_commit_authen_covers_primary_counter():
+    # reference messages/authen.go:70 — commit binds the primary's counter.
+    p = _sample_prepare()
+    c1 = msgs.Commit(replica_id=2, prepare=p)
+    import copy
+
+    p2 = copy.deepcopy(p)
+    p2.ui.counter += 1
+    c2 = msgs.Commit(replica_id=2, prepare=p2)
+    assert msgs.authen_bytes(c1) != msgs.authen_bytes(c2)
+
+
+def test_commit_authen_requires_prepare_ui():
+    p = msgs.Prepare(replica_id=0, view=1, request=_sample_request(), ui=None)
+    with pytest.raises(ValueError):
+        msgs.authen_bytes(msgs.Commit(replica_id=2, prepare=p))
+
+
+def test_stringify_smoke():
+    for m in [
+        msgs.Hello(1),
+        _sample_request(),
+        _sample_prepare(),
+        _sample_commit(),
+        msgs.Reply(replica_id=1, client_id=3, seq=2, result=b"x"),
+        msgs.ReqViewChange(replica_id=1, new_view=2),
+    ]:
+        s = msgs.stringify(m)
+        assert s.startswith("<") and s.endswith(">")
+
+
+def test_malformed_ui_raises_codec_error():
+    # A 1-7 byte UI field must surface as CodecError, not bare ValueError
+    # (error contract of unmarshal for attacker-crafted wire bytes).
+    import struct
+
+    req = msgs.marshal(_sample_request())
+    data = (
+        bytes([0x04])
+        + struct.pack(">I", 0)
+        + struct.pack(">Q", 1)
+        + struct.pack(">I", len(req))
+        + req
+        + struct.pack(">I", 3)
+        + b"abc"
+    )
+    with pytest.raises(msgs.CodecError):
+        msgs.unmarshal(data)
+
+
+def test_out_of_range_fields_raise_codec_error():
+    with pytest.raises(msgs.CodecError):
+        msgs.marshal(msgs.Request(client_id=-1, seq=0, operation=b""))
+    with pytest.raises(msgs.CodecError):
+        msgs.marshal(msgs.Request(client_id=0, seq=2**64, operation=b""))

@@ -1,0 +1,69 @@
+"""Differential tests: JAX SHA-256 / HMAC-SHA256 vs hashlib/hmac."""
+
+import hashlib
+import hmac as py_hmac
+import os
+
+import numpy as np
+import pytest
+
+from minbft_tpu.ops import hmac_sha256, sha256
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        b"",
+        b"abc",
+        b"a" * 55,
+        b"a" * 56,  # padding boundary
+        b"a" * 64,
+        b"hello world" * 20,
+        os.urandom(301),
+    ],
+)
+def test_sha256_matches_hashlib(data):
+    assert sha256.sha256_host(data) == hashlib.sha256(data).digest()
+
+
+def test_sha256_batch():
+    msgs = [os.urandom(32) for _ in range(17)]
+    blocks = np.stack([sha256.pad_message(m) for m in msgs])  # [17, 1, 16]
+    out = np.asarray(sha256.sha256_fixed_batch(blocks))
+    for i, m in enumerate(msgs):
+        assert sha256.words_to_bytes(out[i]) == hashlib.sha256(m).digest()
+
+
+def test_hmac32_matches_hmac_module():
+    rng = np.random.default_rng(0)
+    B = 33
+    keys = rng.integers(0, 2**32, size=(B, 8), dtype=np.uint32)
+    msgs = rng.integers(0, 2**32, size=(B, 8), dtype=np.uint32)
+    macs = np.asarray(hmac_sha256.hmac_sign_kernel(keys, msgs))
+    for i in range(B):
+        expect = py_hmac.new(
+            sha256.words_to_bytes(keys[i]),
+            sha256.words_to_bytes(msgs[i]),
+            hashlib.sha256,
+        ).digest()
+        assert sha256.words_to_bytes(macs[i]) == expect
+
+
+def test_hmac_verify_batch_accepts_and_rejects():
+    rng = np.random.default_rng(1)
+    B = 16
+    keys = rng.integers(0, 2**32, size=(B, 8), dtype=np.uint32)
+    msgs = rng.integers(0, 2**32, size=(B, 8), dtype=np.uint32)
+    macs = np.asarray(hmac_sha256.hmac_sign_kernel(keys, msgs))
+    ok = np.asarray(hmac_sha256.hmac_verify_kernel(keys, msgs, macs))
+    assert ok.all()
+
+    # Corrupt one word of half the macs.
+    bad = macs.copy()
+    bad[::2, 3] ^= 1
+    ok2 = np.asarray(hmac_sha256.hmac_verify_kernel(keys, msgs, bad))
+    assert (~ok2[::2]).all() and ok2[1::2].all()
+
+    # Wrong key rejects.
+    ok3 = np.asarray(hmac_sha256.hmac_verify_kernel(keys[::-1], msgs, macs))
+    assert not ok3.any() or B == 1
