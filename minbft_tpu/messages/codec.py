@@ -87,7 +87,12 @@ def _read_u64(data: bytes, off: int) -> Tuple[int, int]:
 
 
 def _pack_ui(ui) -> bytes:
-    return _pack_bytes(ui.to_bytes() if ui is not None else b"")
+    if ui is None:
+        return _pack_bytes(b"")
+    try:
+        return _pack_bytes(ui.to_bytes())
+    except OverflowError as e:
+        raise CodecError(f"UI counter out of range: {e}") from e
 
 
 def _parse_ui(uib: bytes):

@@ -1,0 +1,299 @@
+"""Batched ECDSA-P256 verification as a JAX/XLA TPU kernel.
+
+This is the north-star hot path: the reference verifies every PREPARE/COMMIT
+UI certificate and client signature serially on CPU (Go crypto/ecdsa at
+sample/authentication/crypto.go:79-89; enclave-side create at
+usig/sgx/enclave/usig.c:36-76, verification in pure Go at
+usig/sgx/sgx-usig.go:81-97).  Here a whole batch of verifications runs as one
+data-parallel XLA program: ``jax.vmap`` over a scalar-shaped verifier whose
+field arithmetic is the limb machinery of :mod:`minbft_tpu.ops.limbs`.
+
+Division of labor (TPU-first):
+
+- **Host** hashes variable-length bytes to the fixed 32-byte digest ``z``
+  (:func:`minbft_tpu.messages.authen_digest`) and computes the two scalars
+  ``u1 = z*s^-1 mod n`` and ``u2 = r*s^-1 mod n`` with native big-int ops —
+  cheap, and it keeps mod-n arithmetic off the device entirely.
+- **Device** does everything expensive: the 256-bit double-scalar
+  multiplication ``u1*G + u2*Q`` (interleaved Shamir ladder, Jacobian
+  coordinates, a = -3 doubling), one Fermat inversion to build the G+Q table
+  entry, final affine conversion, and the ``x(R) ≡ r (mod n)`` check — all
+  constant-shape, batched, jit-compiled once per batch bucket.
+
+Exceptional cases (identity operands, P == ±Q mid-ladder) are handled with
+constant-shape selects, never data-dependent branches, so adversarial
+signatures cannot force a recompile or a trace divergence.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import limbs
+from .limbs import (
+    FieldSpec,
+    add_mod,
+    from_limbs,
+    limbs_eq,
+    mont_inv,
+    mont_mul,
+    mont_one,
+    mont_sqr,
+    sub_mod,
+    to_limbs,
+    to_mont,
+)
+
+# ---------------------------------------------------------------------------
+# Curve constants (NIST P-256 / secp256r1, FIPS 186-4 D.1.2.3).
+
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+FIELD = FieldSpec.make(P)
+ORDER = FieldSpec.make(N)
+
+
+def _const_mont(x: int) -> np.ndarray:
+    """Host-side constant -> Montgomery-domain limbs (numpy, trace-time)."""
+    return to_limbs((x << 256) % P)
+
+
+_GX_M = _const_mont(GX)
+_GY_M = _const_mont(GY)
+_B_M = _const_mont(B)
+
+Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # Jacobian (X, Y, Z), Montgomery
+
+
+def _dbl(p: Point) -> Point:
+    """Jacobian doubling, a = -3 (dbl-2001-b).  Maps identity to identity."""
+    x, y, z = p
+    f = FIELD
+    delta = mont_sqr(f, z)
+    gamma = mont_sqr(f, y)
+    beta = mont_mul(f, x, gamma)
+    t0 = sub_mod(f, x, delta)
+    t1 = add_mod(f, x, delta)
+    alpha = mont_mul(f, add_mod(f, add_mod(f, t0, t0), t0), t1)  # 3(x-d)(x+d)
+    beta4 = add_mod(f, add_mod(f, beta, beta), add_mod(f, beta, beta))
+    beta8 = add_mod(f, beta4, beta4)
+    x3 = sub_mod(f, mont_sqr(f, alpha), beta8)
+    yz = add_mod(f, y, z)
+    z3 = sub_mod(f, sub_mod(f, mont_sqr(f, yz), gamma), delta)
+    g2 = mont_sqr(f, gamma)
+    g8 = add_mod(f, add_mod(f, g2, g2), add_mod(f, g2, g2))
+    g8 = add_mod(f, g8, g8)
+    y3 = sub_mod(f, mont_mul(f, alpha, sub_mod(f, beta4, x3)), g8)
+    return x3, y3, z3
+
+
+def _madd(p: Point, qx: jnp.ndarray, qy: jnp.ndarray, q_inf: jnp.ndarray) -> Point:
+    """Mixed Jacobian + affine addition with full exceptional-case handling.
+
+    q_inf: bool — the affine operand is the identity (then result = p).
+    If p is the identity -> (qx, qy, 1).  If p == q -> doubling.  If
+    p == -q -> identity (falls out of the formula with H = 0, r != 0).
+    All cases resolved via constant-shape selects.
+    """
+    x1, y1, z1 = p
+    f = FIELD
+    z1z1 = mont_sqr(f, z1)
+    u2 = mont_mul(f, qx, z1z1)
+    s2 = mont_mul(f, qy, mont_mul(f, z1, z1z1))
+    h = sub_mod(f, u2, x1)
+    r = sub_mod(f, s2, y1)
+    hh = mont_sqr(f, h)
+    hhh = mont_mul(f, h, hh)
+    v = mont_mul(f, x1, hh)
+    x3 = sub_mod(f, sub_mod(f, mont_sqr(f, r), hhh), add_mod(f, v, v))
+    y3 = sub_mod(f, mont_mul(f, r, sub_mod(f, v, x3)), mont_mul(f, y1, hhh))
+    z3 = mont_mul(f, z1, h)
+
+    p_inf = limbs.is_zero(z1)
+    same_x = limbs.is_zero(h)
+    same_y = limbs.is_zero(r)
+    dblx, dbly, dblz = _dbl(p)
+
+    one = mont_one(f)
+
+    def sel(c, a, b):
+        return jnp.where(c, a, b)
+
+    # doubling case (p == q)
+    use_dbl = jnp.logical_and(same_x, same_y) & ~p_inf & ~q_inf
+    x3 = sel(use_dbl, dblx, x3)
+    y3 = sel(use_dbl, dbly, y3)
+    z3 = sel(use_dbl, dblz, z3)
+    # p is identity -> q
+    x3 = sel(p_inf, qx, x3)
+    y3 = sel(p_inf, qy, y3)
+    z3 = sel(p_inf, sel(q_inf, jnp.zeros_like(one), one), z3)
+    # q is identity -> p
+    x3 = sel(q_inf & ~p_inf, x1, x3)
+    y3 = sel(q_inf & ~p_inf, y1, y3)
+    z3 = sel(q_inf & ~p_inf, z1, z3)
+    return x3, y3, z3
+
+
+def _to_affine(p: Point) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Jacobian Montgomery -> affine *normal-domain* (x, y), plus inf flag."""
+    x, y, z = p
+    f = FIELD
+    inf = limbs.is_zero(z)
+    zsafe = jnp.where(inf, mont_one(f), z)
+    zi = mont_inv(f, zsafe)
+    zi2 = mont_sqr(f, zi)
+    ax = mont_mul(f, x, zi2)
+    ay = mont_mul(f, y, mont_mul(f, zi, zi2))
+    return limbs.from_mont(f, ax), limbs.from_mont(f, ay), inf
+
+
+def _bit_at(scalar: jnp.ndarray, j) -> jnp.ndarray:
+    """Bit j (0 = LSB) of a [16]-limb scalar, traced index."""
+    word = lax.dynamic_index_in_dim(scalar, j >> 4, keepdims=False)
+    return (word >> (j & 15).astype(jnp.uint32)) & jnp.uint32(1)
+
+
+def _shamir(u1: jnp.ndarray, u2: jnp.ndarray, qx_m: jnp.ndarray, qy_m: jnp.ndarray) -> Point:
+    """Interleaved double-scalar multiplication u1*G + u2*Q.
+
+    256 iterations of double-then-select-add against the 3-entry affine
+    table {G, Q, G+Q}; the G+Q entry is built on device with one Fermat
+    inversion.  Everything is one ``fori_loop``: the compiled program is a
+    handful of loop nodes regardless of batch size.
+    """
+    f = FIELD
+    one = mont_one(f)
+    gx = jnp.asarray(_GX_M)
+    gy = jnp.asarray(_GY_M)
+
+    # Table entry G+Q (affine). Exceptional Q == ±G handled by _madd/_to_affine.
+    gq = _madd((gx, gy, one), qx_m, qy_m, jnp.bool_(False))
+    gq_xm, gq_ym, gq_z = gq
+    gq_inf = limbs.is_zero(gq_z)
+    zsafe = jnp.where(gq_inf, one, gq_z)
+    zi = mont_inv(f, zsafe)
+    zi2 = mont_sqr(f, zi)
+    gqx = mont_mul(f, gq_xm, zi2)
+    gqy = mont_mul(f, gq_ym, mont_mul(f, zi, zi2))
+
+    # Affine table stacked on a leading index axis, indexed by
+    # d = 2*bit(u1) + bit(u2): [none, Q, G, G+Q].
+    zeros = jnp.zeros_like(one)
+    tab_x = jnp.stack([zeros, qx_m, gx, gqx])
+    tab_y = jnp.stack([zeros, qy_m, gy, gqy])
+    tab_inf = jnp.stack(
+        [jnp.bool_(True), jnp.bool_(False), jnp.bool_(False), gq_inf]
+    )
+
+    def body(i, acc):
+        j = (255 - i).astype(jnp.int32)
+        acc = _dbl(acc)
+        d = (_bit_at(u1, j) * 2 + _bit_at(u2, j)).astype(jnp.int32)
+        ax = lax.dynamic_index_in_dim(tab_x, d, keepdims=False)
+        ay = lax.dynamic_index_in_dim(tab_y, d, keepdims=False)
+        ainf = lax.dynamic_index_in_dim(tab_inf, d, keepdims=False)
+        return _madd(acc, ax, ay, ainf)
+
+    start: Point = (one, one, jnp.zeros_like(one))  # identity
+    return lax.fori_loop(0, 256, body, start)
+
+
+def _verify_one(
+    qx: jnp.ndarray,
+    qy: jnp.ndarray,
+    u1: jnp.ndarray,
+    u2: jnp.ndarray,
+    r: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """Scalar-shaped ECDSA verify core; all limb args [16] u32, normal domain.
+
+    ``valid`` carries host-side range checks (r, s in [1, n-1]); the kernel
+    AND-folds it so invalid inputs burn the same cycles as valid ones
+    (constant shape) but always return False.
+    """
+    f = FIELD
+    qx_m = to_mont(f, qx)
+    qy_m = to_mont(f, qy)
+    rx, _, inf = _to_affine(_shamir(u1, u2, qx_m, qy_m))
+    # x(R) mod n == r, given x(R) < p < 2n: true iff rx == r or rx - n == r.
+    n_limbs = jnp.asarray(ORDER.modulus)
+    rx_red = jnp.where(
+        limbs._geq(rx, n_limbs), limbs._sub_limbs(rx, n_limbs), rx
+    )
+    ok = limbs_eq(rx_red, r) | limbs_eq(rx, r)
+    return ok & ~inf & valid
+
+
+_verify_batch = jax.jit(jax.vmap(_verify_one))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_for_bucket(_: int):
+    # One cached jitted callable per bucket size (jit caches by shape anyway;
+    # the lru_cache just makes the bucketing explicit and introspectable).
+    return _verify_batch
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch preparation.
+
+
+def prepare_batch(
+    items: Sequence[Tuple[Tuple[int, int], bytes, Tuple[int, int]]],
+) -> Tuple[np.ndarray, ...]:
+    """[(pubkey (x, y), digest32, (r, s))] -> device-ready limb arrays.
+
+    Host computes w = s^-1 mod n, u1 = z*w, u2 = r*w (mod n) with Python
+    big ints; out-of-range signatures get valid=False and dummy scalars so
+    the batch shape never changes.
+    """
+    b = len(items)
+    qx = np.zeros((b, limbs.NLIMBS), np.uint32)
+    qy = np.zeros((b, limbs.NLIMBS), np.uint32)
+    u1 = np.zeros((b, limbs.NLIMBS), np.uint32)
+    u2 = np.zeros((b, limbs.NLIMBS), np.uint32)
+    rr = np.zeros((b, limbs.NLIMBS), np.uint32)
+    valid = np.zeros((b,), np.bool_)
+    for i, ((x, y), digest, (r, s)) in enumerate(items):
+        if not (0 < r < N and 0 < s < N and 0 <= x < P and 0 <= y < P):
+            continue
+        z = int.from_bytes(digest[:32], "big") % N
+        w = pow(s, -1, N)
+        qx[i] = to_limbs(x)
+        qy[i] = to_limbs(y)
+        u1[i] = to_limbs((z * w) % N)
+        u2[i] = to_limbs((r * w) % N)
+        rr[i] = to_limbs(r)
+        valid[i] = True
+    return qx, qy, u1, u2, rr, valid
+
+
+def verify_batch(
+    items: Sequence[Tuple[Tuple[int, int], bytes, Tuple[int, int]]],
+) -> np.ndarray:
+    """Convenience wrapper: prepare on host, verify on device -> [B] bool."""
+    arrays = prepare_batch(items)
+    return np.asarray(_verify_batch(*[jnp.asarray(a) for a in arrays]))
+
+
+ecdsa_verify_kernel = _verify_batch  # the raw jitted batch entry point
+
+
+def is_on_curve(x: int, y: int) -> bool:
+    """Host-side curve membership check for keystore loading (not hot path)."""
+    if not (0 <= x < P and 0 <= y < P):
+        return False
+    return (y * y - (x * x * x - 3 * x + B)) % P == 0

@@ -1,0 +1,1 @@
+"""Shared utilities: host-side crypto reference, logging, timing counters."""

@@ -1,0 +1,257 @@
+"""Host-side elliptic-curve crypto with Python big ints.
+
+Pure-Python P-256 and Ed25519: key generation, signing (RFC 6979
+deterministic nonces for ECDSA), and a reference verifier.  Three jobs:
+
+1. **Signing** — replicas/clients sign with host code (one signature per
+   outgoing message; generation is inherently serial per-key because the
+   USIG counter must increment atomically, reference usig/sgx/enclave/
+   usig.c:66-69).  A faster C++ implementation lives in
+   ``minbft_tpu/native`` and is preferred when built; this module is the
+   always-available fallback and the semantic reference.
+2. **Differential testing** — the TPU kernels (:mod:`minbft_tpu.ops.p256`,
+   :mod:`minbft_tpu.ops.ed25519`) are tested bit-for-bit against these
+   functions on random and adversarial inputs.
+3. **Key generation** for the keystore/keytool (reference
+   sample/authentication/keymanager.go:404-450).
+
+Standard-library only (hashlib, hmac, secrets): nothing here may depend on
+packages that are not baked into the image.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from typing import Tuple
+
+# ---------------------------------------------------------------------------
+# NIST P-256.
+
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+A = P - 3
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+# Affine points as (x, y); None is the identity.
+PointA = Tuple[int, int]
+
+
+def _inv(x: int, m: int) -> int:
+    return pow(x, -1, m)
+
+
+def point_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    x1, y1 = p
+    x2, y2 = q
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        return point_double(p)
+    lam = ((y2 - y1) * _inv(x2 - x1, P)) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def point_double(p):
+    if p is None:
+        return None
+    x1, y1 = p
+    if y1 == 0:
+        return None
+    lam = ((3 * x1 * x1 + A) * _inv(2 * y1, P)) % P
+    x3 = (lam * lam - 2 * x1) % P
+    return x3, (lam * (x1 - x3) - y1) % P
+
+
+def scalar_mult(k: int, p: PointA):
+    """Double-and-add (host side is not secret-latency sensitive for tests;
+    production signing uses the native module)."""
+    acc = None
+    addend = p
+    while k:
+        if k & 1:
+            acc = point_add(acc, addend)
+        addend = point_double(addend)
+        k >>= 1
+    return acc
+
+
+def keygen(rng=None) -> Tuple[int, PointA]:
+    """-> (private scalar d, public point Q = d*G)."""
+    d = (rng or secrets).randbelow(N - 1) + 1
+    return d, scalar_mult(d, (GX, GY))
+
+
+def _rfc6979_k(d: int, z: int, order: int = N) -> int:
+    """RFC 6979 deterministic nonce (HMAC-SHA256 DRBG)."""
+    qlen = 32
+    x = d.to_bytes(qlen, "big")
+    h1 = (z % order).to_bytes(qlen, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < order:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def ecdsa_sign(d: int, digest: bytes) -> Tuple[int, int]:
+    """ECDSA-P256 over a 32-byte digest -> (r, s). Deterministic (RFC 6979)."""
+    z = int.from_bytes(digest[:32], "big") % N
+    while True:
+        k = _rfc6979_k(d, z)
+        x1, _ = scalar_mult(k, (GX, GY))
+        r = x1 % N
+        if r == 0:
+            z = (z + 1) % N  # astronomically unlikely; reroll deterministically
+            continue
+        s = (_inv(k, N) * (z + r * d)) % N
+        if s == 0:
+            z = (z + 1) % N
+            continue
+        return r, s
+
+
+def ecdsa_verify(q: PointA, digest: bytes, sig: Tuple[int, int]) -> bool:
+    """Reference verifier (host big ints) — the oracle for the TPU kernel."""
+    r, s = sig
+    if not (0 < r < N and 0 < s < N):
+        return False
+    z = int.from_bytes(digest[:32], "big") % N
+    w = _inv(s, N)
+    u1 = (z * w) % N
+    u2 = (r * w) % N
+    pt = point_add(scalar_mult(u1, (GX, GY)), scalar_mult(u2, q))
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+# ---------------------------------------------------------------------------
+# Ed25519 (RFC 8032). Used by the Ed25519 authenticator (BASELINE config[4]).
+
+ED_P = 2**255 - 19
+ED_L = 2**252 + 27742317777372353535851937790883648493
+ED_D = (-121665 * pow(121666, -1, ED_P)) % ED_P
+ED_BY = (4 * pow(5, -1, ED_P)) % ED_P
+_bx_num = pow((ED_BY * ED_BY - 1) % ED_P, 1, ED_P)
+
+
+def _ed_recover_x(y: int, sign: int):
+    xx = (y * y - 1) * pow(ED_D * y * y + 1, -1, ED_P) % ED_P
+    x = pow(xx, (ED_P + 3) // 8, ED_P)
+    if (x * x - xx) % ED_P != 0:
+        x = x * pow(2, (ED_P - 1) // 4, ED_P) % ED_P
+    if (x * x - xx) % ED_P != 0:
+        return None
+    if x & 1 != sign:
+        x = ED_P - x
+    return x
+
+
+ED_BX = _ed_recover_x(ED_BY, 0)
+
+# Extended coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z.
+EdPoint = Tuple[int, int, int, int]
+ED_IDENT: EdPoint = (0, 1, 1, 0)
+ED_BASE: EdPoint = (ED_BX, ED_BY, 1, ED_BX * ED_BY % ED_P)
+
+
+def ed_add(p: EdPoint, q: EdPoint) -> EdPoint:
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % ED_P
+    b = (y1 + x1) * (y2 + x2) % ED_P
+    c = 2 * t1 * t2 * ED_D % ED_P
+    d = 2 * z1 * z2 % ED_P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return e * f % ED_P, g * h % ED_P, f * g % ED_P, e * h % ED_P
+
+
+def ed_scalar_mult(k: int, p: EdPoint) -> EdPoint:
+    acc = ED_IDENT
+    while k:
+        if k & 1:
+            acc = ed_add(acc, p)
+        p = ed_add(p, p)
+        k >>= 1
+    return acc
+
+
+def ed_compress(p: EdPoint) -> bytes:
+    x, y, z, _ = p
+    zi = pow(z, -1, ED_P)
+    x, y = x * zi % ED_P, y * zi % ED_P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def ed_decompress(data: bytes):
+    if len(data) != 32:
+        return None
+    y = int.from_bytes(data, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    if y >= ED_P:
+        return None
+    x = _ed_recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % ED_P)
+
+
+def ed25519_keygen(seed: bytes | None = None) -> Tuple[bytes, bytes]:
+    """-> (seed32, public key 32B compressed)."""
+    seed = seed if seed is not None else secrets.token_bytes(32)
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return seed, ed_compress(ed_scalar_mult(a, ED_BASE))
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    pub = ed_compress(ed_scalar_mult(a, ED_BASE))
+    r = int.from_bytes(hashlib.sha512(h[32:] + msg).digest(), "little") % ED_L
+    rp = ed_compress(ed_scalar_mult(r, ED_BASE))
+    k = int.from_bytes(hashlib.sha512(rp + pub + msg).digest(), "little") % ED_L
+    s = (r + k * a) % ED_L
+    return rp + s.to_bytes(32, "little")
+
+
+def ed25519_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Reference verifier: checks 8sB == 8R + 8kA (cofactored, RFC 8032)."""
+    if len(sig) != 64:
+        return False
+    rp = ed_decompress(sig[:32])
+    ap = ed_decompress(pub)
+    if rp is None or ap is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= ED_L:
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % ED_L
+    lhs = ed_scalar_mult(8 * s, ED_BASE)
+    rhs = ed_add(ed_scalar_mult(8, rp), ed_scalar_mult(8 * k, ap))
+    # Compare projectively: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1.
+    x1, y1, z1, _ = lhs
+    x2, y2, z2, _ = rhs
+    return (x1 * z2 - x2 * z1) % ED_P == 0 and (y1 * z2 - y2 * z1) % ED_P == 0

@@ -5,14 +5,20 @@ this build (the reference's analogue is running the SGX enclave in simulation
 mode, reference usig/sgx/Makefile SGX_MODE=SIM): CI needs no TPU, while the
 sharding/collective code paths still execute against a real 8-device mesh.
 
-Must set env vars before jax is imported anywhere.
+The environment may pre-register a TPU plugin via sitecustomize and pin
+``JAX_PLATFORMS``; env vars alone therefore don't stick.  XLA_FLAGS must be
+in place before the CPU client is (lazily) created, and the platform is
+forced through ``jax.config`` which wins over the env var.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
