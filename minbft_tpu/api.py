@@ -1,0 +1,164 @@
+"""External-module API contracts.
+
+Mirrors the reference ``api`` package (reference api/api.go:26-159): the core
+protocol engine sees *only* these interfaces; concrete crypto, transport,
+config, and state-machine implementations are plugged in from outside
+(reference README.md:460-478 design stance).  The asyncio re-design changes
+two things relative to the Go contracts:
+
+- Message streams are ``AsyncIterator[bytes]`` instead of Go channels
+  (reference api/api.go:80-91 ``MessageStreamHandler.HandleMessageStream``).
+- ``Authenticator.verify_message_authen_tag`` is a **coroutine**: the TPU
+  authenticator accumulates concurrent verifications into one batched XLA
+  kernel dispatch, so verification must be awaitable (the reference verifies
+  serially and synchronously, sample/authentication/crypto.go:79-89 — this
+  is the north-star restructuring).
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+from typing import AsyncIterator, Awaitable, Callable, Optional, Sequence
+
+
+class AuthenticationRole(enum.Enum):
+    """Which key family authenticates a message
+    (reference api/authentication.go roles; api/api.go:99-120)."""
+
+    REPLICA = "replica"  # replica signatures (REPLY, REQ-VIEW-CHANGE)
+    CLIENT = "client"  # client signatures (REQUEST)
+    USIG = "usig"  # USIG UI certificates (PREPARE, COMMIT)
+
+
+class AuthenticationError(Exception):
+    """Tag failed to verify."""
+
+
+class Authenticator(abc.ABC):
+    """Message authentication provider (reference api/api.go:93-132).
+
+    ``generate`` is synchronous (local signing, serial per-key by nature —
+    the USIG counter must increment atomically).  ``verify`` is awaitable so
+    implementations can batch many in-flight verifications into one TPU
+    kernel dispatch (see minbft_tpu/parallel/engine.py).
+    """
+
+    @abc.abstractmethod
+    def generate_message_authen_tag(
+        self, role: AuthenticationRole, msg: bytes
+    ) -> bytes:
+        """Sign/certify ``msg`` under own key for ``role`` -> tag bytes."""
+
+    @abc.abstractmethod
+    async def verify_message_authen_tag(
+        self, role: AuthenticationRole, peer_id: int, msg: bytes, tag: bytes
+    ) -> None:
+        """Verify ``tag`` over ``msg`` against ``peer_id``'s key for
+        ``role``; raises :class:`AuthenticationError` on failure."""
+
+
+class Configer(abc.ABC):
+    """Protocol configuration provider (reference api/api.go:34-53)."""
+
+    @property
+    @abc.abstractmethod
+    def n(self) -> int:
+        """Total number of replicas."""
+
+    @property
+    @abc.abstractmethod
+    def f(self) -> int:
+        """Maximum tolerated faulty replicas (n >= 2f+1)."""
+
+    @property
+    def checkpoint_period(self) -> int:
+        """Reserved (reference roadmap README.md:492-493)."""
+        return 0
+
+    @property
+    def logsize(self) -> int:
+        """Reserved (reference roadmap README.md:492-493)."""
+        return 0
+
+    @property
+    def timeout_request(self) -> float:
+        """Seconds before a pending request triggers view-change demand."""
+        return 2.0
+
+    @property
+    def timeout_prepare(self) -> float:
+        """Seconds a backup waits for its request to be prepared before
+        forwarding it to the primary."""
+        return 1.0
+
+
+class MessageStreamHandler(abc.ABC):
+    """Bidirectional stream of serialized messages
+    (reference api/api.go:80-91): consume an async stream of request bytes,
+    yield reply bytes.  Eventual delivery / ordering caveats as documented
+    at reference api/api.go:69-78."""
+
+    @abc.abstractmethod
+    def handle_message_stream(
+        self, in_stream: AsyncIterator[bytes]
+    ) -> AsyncIterator[bytes]:
+        ...
+
+
+class ConnectionHandler(abc.ABC):
+    """Server side of a connection: resolves per-kind stream handlers
+    (reference api/api.go:55-67)."""
+
+    @abc.abstractmethod
+    def peer_message_stream_handler(self) -> MessageStreamHandler:
+        ...
+
+    @abc.abstractmethod
+    def client_message_stream_handler(self) -> MessageStreamHandler:
+        ...
+
+
+class ReplicaConnector(abc.ABC):
+    """Client side of connections to replicas (reference api/api.go:64-78)."""
+
+    @abc.abstractmethod
+    def replica_message_stream_handler(
+        self, replica_id: int
+    ) -> Optional[MessageStreamHandler]:
+        """Handler speaking to ``replica_id``; None if unknown."""
+
+
+class RequestConsumer(abc.ABC):
+    """The replicated state machine (reference api/api.go:134-153)."""
+
+    @abc.abstractmethod
+    def deliver(self, operation: bytes) -> "Awaitable[bytes]":
+        """Execute an ordered operation; awaitable resolves to the result
+        bytes (reference: Deliver returns a result channel,
+        sample/requestconsumer/simpleledger.go:146-151)."""
+
+    @abc.abstractmethod
+    def state_digest(self) -> bytes:
+        """Digest of the current application state
+        (reference api/api.go:148-152)."""
+
+
+class Replica(abc.ABC):
+    """A running replica instance (reference api/api.go:155-159)."""
+
+    @abc.abstractmethod
+    def peer_message_stream_handler(self) -> MessageStreamHandler:
+        ...
+
+    @abc.abstractmethod
+    def client_message_stream_handler(self) -> MessageStreamHandler:
+        ...
+
+    @abc.abstractmethod
+    async def start(self) -> None:
+        """Connect to peers and start processing."""
+
+    @abc.abstractmethod
+    async def stop(self) -> None:
+        """Stop background tasks."""

@@ -1,0 +1,170 @@
+"""Client implementation.
+
+Reference structure (client/):
+
+- request pipeline: construct -> sign(ClientAuthen over AuthenBytes) ->
+  single-capacity request buffer -> broadcast stream to n sender tasks
+  (reference client/request.go:186-204, requestbuffer.go:59-88);
+- per-replica connection task pair: outgoing pumps the request stream,
+  incoming authenticates REPLYs (ReplicaAuthen + client-ID check,
+  reference client/message-handling.go:161-170) and feeds the collector;
+- collector: f+1 matching replies by SHA256(result), dedup'd by replica ID
+  (reference client/request.go:83-97, requestbuffer.go:219-236).
+
+The asyncio port keeps the one-request-in-flight-per-client gate as a lock
+(the reference blocks in AddRequest until the prior request is removed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+from typing import AsyncIterator, Dict, Optional
+
+from .. import api
+from ..messages import Reply, Request, authen_bytes, marshal, unmarshal
+
+
+class _PendingRequest:
+    def __init__(self, seq: int, f: int):
+        self.seq = seq
+        self.f = f
+        self.replies_by_replica: Dict[int, bytes] = {}
+        self.count_by_digest: Dict[bytes, int] = {}
+        self.result: asyncio.Future = asyncio.get_event_loop().create_future()
+
+    def add_reply(self, reply: Reply) -> None:
+        if reply.replica_id in self.replies_by_replica:
+            return  # one vote per replica (reference requestbuffer.go:219-236)
+        self.replies_by_replica[reply.replica_id] = reply.result
+        digest = hashlib.sha256(reply.result).digest()
+        cnt = self.count_by_digest.get(digest, 0) + 1
+        self.count_by_digest[digest] = cnt
+        if cnt >= self.f + 1 and not self.result.done():
+            self.result.set_result(reply.result)
+
+
+class Client:
+    def __init__(
+        self,
+        client_id: int,
+        n: int,
+        f: int,
+        authenticator: api.Authenticator,
+        connector: api.ReplicaConnector,
+        seq_start: Optional[int] = None,
+    ):
+        if n < 2 * f + 1:
+            raise ValueError(f"n must be at least 2f+1 (n={n}, f={f})")
+        self.client_id = client_id
+        self.n = n
+        self.f = f
+        self._auth = authenticator
+        self._connector = connector
+        # Sequence numbers seeded from wall clock so a restarted client
+        # doesn't reuse sequences (reference client/request.go:209-217).
+        self._seq = seq_start if seq_start is not None else time.time_ns()
+        self._seq_lock = asyncio.Lock()  # one request in flight per client
+        self._pending: Optional[_PendingRequest] = None
+        self._queues: Dict[int, asyncio.Queue] = {}
+        self._tasks: list = []
+        self._started = False
+
+    # -- connections --------------------------------------------------------
+
+    async def start(self) -> None:
+        loop = asyncio.get_event_loop()
+        for rid in range(self.n):
+            handler = self._connector.replica_message_stream_handler(rid)
+            if handler is None:
+                raise ValueError(f"no connection for replica {rid}")
+            q: asyncio.Queue = asyncio.Queue()
+            self._queues[rid] = q
+            self._tasks.append(loop.create_task(self._run_connection(rid, handler, q)))
+        self._started = True
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        self._started = False
+
+    async def _run_connection(
+        self, replica_id: int, handler: api.MessageStreamHandler, q: asyncio.Queue
+    ) -> None:
+        async def outgoing() -> AsyncIterator[bytes]:
+            while True:
+                yield await q.get()
+
+        try:
+            async for data in handler.handle_message_stream(outgoing()):
+                await self._handle_reply(replica_id, data)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # A faulty replica connection must not break the client: f+1
+            # matching replies from the others still complete requests.
+            pass
+
+    async def _handle_reply(self, replica_id: int, data: bytes) -> None:
+        try:
+            msg = unmarshal(data)
+        except Exception:
+            return
+        if not isinstance(msg, Reply):
+            return
+        # Authenticate and attribute (reference client/message-handling.go:161-170).
+        if msg.replica_id != replica_id or msg.client_id != self.client_id:
+            return
+        try:
+            await self._auth.verify_message_authen_tag(
+                api.AuthenticationRole.REPLICA,
+                msg.replica_id,
+                authen_bytes(msg),
+                msg.signature,
+            )
+        except api.AuthenticationError:
+            return
+        pending = self._pending
+        if pending is not None and msg.seq == pending.seq:
+            pending.add_reply(msg)
+
+    # -- requests -----------------------------------------------------------
+
+    async def request(self, operation: bytes, timeout: Optional[float] = None) -> bytes:
+        """Submit an operation; resolves once f+1 replicas agree on the
+        result (reference client/client.go:66-71 Request)."""
+        if not self._started:
+            raise RuntimeError("client not started")
+        async with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+            req = Request(client_id=self.client_id, seq=seq, operation=operation)
+            req.signature = self._auth.generate_message_authen_tag(
+                api.AuthenticationRole.CLIENT, authen_bytes(req)
+            )
+            pending = _PendingRequest(seq, self.f)
+            self._pending = pending
+            data = marshal(req)
+            for q in self._queues.values():
+                await q.put(data)
+            try:
+                if timeout is not None:
+                    return await asyncio.wait_for(pending.result, timeout)
+                return await pending.result
+            finally:
+                self._pending = None
+
+
+def new_client(
+    client_id: int,
+    n: int,
+    f: int,
+    authenticator: api.Authenticator,
+    connector: api.ReplicaConnector,
+    **kw,
+) -> Client:
+    """Create a client (reference client.New, client/client.go:51-64)."""
+    return Client(client_id, n, f, authenticator, connector, **kw)

@@ -1,0 +1,138 @@
+"""Commit pipeline and quorum counting (reference core/commit.go).
+
+A COMMIT must come from a backup (never the view's primary, reference
+commit.go:78-80) and embeds the full PREPARE it commits to; validation
+re-validates the embedded PREPARE *and* the backup's own UI — up to three
+signature checks that the TPU authenticator folds into one batch.
+
+The commitment collector is the quorum core (reference commit.go:108-201):
+
+- the **acceptor** enforces that each replica's commitments arrive with
+  sequential primary-CVs (no gaps, no replays) per view;
+- the **counter** counts distinct committers per (view, primary-CV) and
+  signals "done" at f+1 (the primary's own PREPARE counts itself);
+- completed quorums release the executor strictly in primary-CV order.
+
+In the reference all of this is mutex-serialized per message
+(commit.go:128-129); here the await points sit *after* batched validation,
+so quorum accounting is pure in-memory bookkeeping on the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Dict, Set, Tuple
+
+from .. import api
+from ..messages import Commit, Prepare
+from . import utils
+
+
+def make_commit_validator(
+    n: int,
+    validate_prepare,
+    verify_ui,
+) -> Callable[[Commit], Awaitable[None]]:
+    """Reference makeCommitValidator (core/commit.go:74-92)."""
+
+    async def validate_commit(commit: Commit) -> None:
+        prepare = commit.prepare
+        if utils.is_primary(prepare.view, commit.replica_id, n):
+            raise api.AuthenticationError(
+                "COMMIT must not come from the view's primary"
+            )
+        await asyncio.gather(validate_prepare(prepare), verify_ui(commit))
+
+    return validate_commit
+
+
+def make_commit_applier(
+    collect_commitment,
+) -> Callable[[Commit], Awaitable[None]]:
+    """Reference makeCommitApplier (core/commit.go:96-104)."""
+
+    async def apply_commit(commit: Commit) -> None:
+        await collect_commitment(commit.replica_id, commit.prepare)
+
+    return apply_commit
+
+
+class CommitmentCollector:
+    """Acceptor + counter + in-order executor release
+    (reference makeCommitmentCollector/Acceptor/Counter,
+    core/commit.go:108-201)."""
+
+    def __init__(self, f: int, execute_request):
+        self._f = f
+        self._execute = execute_request
+        self._lock = asyncio.Lock()
+        self._exec_lock = asyncio.Lock()  # serializes state-machine execution
+        # acceptor state: per replica, last accepted primary-CV per view
+        self._last_cv: Dict[Tuple[int, int], int] = {}  # (view, replica) -> cv
+        # counter state: per (view, primary-cv), set of committers
+        self._committers: Dict[Tuple[int, int], Set[int]] = {}
+        self._done: Set[Tuple[int, int]] = set()
+        # executor-release state: next primary CV to execute per view
+        self._next_exec_cv: Dict[int, int] = {}
+        self._ready: Dict[Tuple[int, int], Prepare] = {}
+
+    async def collect(self, replica_id: int, prepare: Prepare) -> None:
+        """Account one commitment by ``replica_id`` to ``prepare``; executes
+        request(s) whose quorum completes.  Raises AuthenticationError for
+        protocol violations (non-sequential CVs — reference
+        commit.go:162-166)."""
+        view = prepare.view
+        primary_cv = prepare.ui.counter
+        async with self._lock:
+            key = (view, replica_id)
+            last = self._last_cv.get(key, 0)
+            if primary_cv <= last:
+                return  # replayed commitment — already accounted
+            if primary_cv != last + 1:
+                raise api.AuthenticationError(
+                    f"replica {replica_id} commitment skips CV "
+                    f"{last + 1} -> {primary_cv}"
+                )
+            self._last_cv[key] = primary_cv
+
+            ckey = (view, primary_cv)
+            if ckey in self._done:
+                return
+            committers = self._committers.setdefault(ckey, set())
+            committers.add(replica_id)
+            if len(committers) < self._f + 1:
+                return
+            self._done.add(ckey)
+            del self._committers[ckey]
+            self._ready[ckey] = prepare
+        await self._drain(view)
+
+    async def _drain(self, view: int) -> None:
+        """Execute completed quorums strictly in primary-CV order.
+
+        ``_exec_lock`` is held across ``deliver`` so a suspended execution
+        (an actually-awaiting consumer) cannot be overtaken by a later CV
+        whose quorum completes meanwhile — batched validation makes such
+        reordering a real possibility, and hash-chained state machines
+        diverge if two replicas execute in different orders."""
+        async with self._exec_lock:
+            while True:
+                async with self._lock:
+                    nxt = self._next_exec_cv.setdefault(view, 1)
+                    prepare = self._ready.pop((view, nxt), None)
+                    if prepare is not None:
+                        self._next_exec_cv[view] = nxt + 1
+                if prepare is None:
+                    return
+                await self._execute(prepare.request)
+
+
+def make_commitment_collector(
+    f: int, execute_request
+) -> Callable[[int, Prepare], Awaitable[None]]:
+    collector = CommitmentCollector(f, execute_request)
+
+    async def collect_commitment(replica_id: int, prepare: Prepare) -> None:
+        await collector.collect(replica_id, prepare)
+
+    return collect_commitment

@@ -1,0 +1,138 @@
+"""Per-client state: request-seq lifecycle, reply buffer, timers.
+
+Reference core/internal/clientstate/: three sub-machines per client —
+
+- request-seq lifecycle captured→released→prepared→retired with a blocking
+  capture (reference request-seq.go:47-112): this is the per-client
+  pipelining/dedup gate — one request in flight per client, strictly
+  increasing sequence numbers, parallel across clients;
+- reply buffer with per-seq subscription (reference reply.go:41-90);
+- restartable single-slot request/prepare timers (reference timeout.go:40-71),
+  injectable for tests (reference timer mock).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, Optional
+
+from .timer import TimerProvider, StandardTimerProvider
+
+
+class ClientState:
+    def __init__(self, timer_provider: TimerProvider):
+        self._timers = timer_provider
+        # request-seq state machine (reference request-seq.go:28-45)
+        self._last_captured = 0
+        self._last_released = 0
+        self._prepared: Dict[int, bool] = {}
+        self._retired = 0
+        self._cond = asyncio.Condition()
+        # reply buffer (reference reply.go)
+        self._replies: Dict[int, object] = {}
+        self._reply_events: Dict[int, asyncio.Event] = {}
+        # timers (reference timeout.go)
+        self._request_timer = None
+        self._prepare_timer = None
+
+    # -- request sequence lifecycle -----------------------------------------
+
+    async def capture_request_seq(self, seq: int) -> bool:
+        """Capture ``seq`` for processing.
+
+        Returns False if ``seq`` was already captured (duplicate).  Blocks
+        while a prior capture is unreleased (the per-client serialization of
+        reference request-seq.go:47-82)."""
+        async with self._cond:
+            while self._last_captured != self._last_released:
+                if seq <= self._last_captured:
+                    return False
+                await self._cond.wait()
+            if seq <= self._last_captured:
+                return False
+            self._last_captured = seq
+            return True
+
+    async def release_request_seq(self, seq: int) -> None:
+        """Finish processing a captured seq (reference request-seq.go:84-97)."""
+        async with self._cond:
+            if seq != self._last_captured or self._last_released == seq:
+                raise ValueError("release of non-captured request seq")
+            self._last_released = seq
+            self._cond.notify_all()
+
+    def prepare_request_seq(self, seq: int) -> None:
+        """Mark ``seq`` prepared (reference request-seq.go:99-106)."""
+        self._prepared[seq] = True
+
+    def retire_request_seq(self, seq: int) -> bool:
+        """Mark ``seq`` executed; returns False if already retired
+        (reference request-seq.go:108-112)."""
+        if seq <= self._retired:
+            return False
+        self._retired = seq
+        return True
+
+    @property
+    def last_captured_seq(self) -> int:
+        return self._last_captured
+
+    # -- reply buffer --------------------------------------------------------
+
+    def add_reply(self, seq: int, reply) -> None:
+        """Store the reply for ``seq`` and wake subscribers
+        (reference reply.go:41-64)."""
+        self._replies[seq] = reply
+        ev = self._reply_events.get(seq)
+        if ev is not None:
+            ev.set()
+
+    async def reply_for(self, seq: int) -> object:
+        """Await the reply for ``seq`` (reference reply.go:66-90
+        ReplyChannel subscription)."""
+        if seq in self._replies:
+            return self._replies[seq]
+        ev = self._reply_events.setdefault(seq, asyncio.Event())
+        await ev.wait()
+        return self._replies[seq]
+
+    # -- timers --------------------------------------------------------------
+
+    def start_request_timer(self, timeout: float, on_expiry: Callable[[], None]) -> None:
+        """(Re)start the single-slot request timer (reference timeout.go:40-56)."""
+        self.stop_request_timer()
+        if timeout > 0:
+            self._request_timer = self._timers.after(timeout, on_expiry)
+
+    def stop_request_timer(self) -> None:
+        if self._request_timer is not None:
+            self._request_timer.cancel()
+            self._request_timer = None
+
+    def start_prepare_timer(self, timeout: float, on_expiry: Callable[[], None]) -> None:
+        self.stop_prepare_timer()
+        if timeout > 0:
+            self._prepare_timer = self._timers.after(timeout, on_expiry)
+
+    def stop_prepare_timer(self) -> None:
+        if self._prepare_timer is not None:
+            self._prepare_timer.cancel()
+            self._prepare_timer = None
+
+
+class ClientStates:
+    """Lazily-populated per-client provider (reference client-state.go:36-55)."""
+
+    def __init__(self, timer_provider: Optional[TimerProvider] = None):
+        self._timers = timer_provider or StandardTimerProvider()
+        self._clients: Dict[int, ClientState] = {}
+
+    def client(self, client_id: int) -> ClientState:
+        st = self._clients.get(client_id)
+        if st is None:
+            st = ClientState(self._timers)
+            self._clients[client_id] = st
+        return st
+
+    def all(self):
+        return self._clients.items()

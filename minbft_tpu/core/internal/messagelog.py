@@ -1,0 +1,66 @@
+"""Append-only message log with multi-subscriber replay streams.
+
+Reference core/internal/messagelog/messagelog.go:40-109: ``append`` never
+blocks; each ``stream()`` first replays everything logged so far, then
+follows new appends until the ``done`` event is set (or the consuming task
+is cancelled).  Used for the broadcast log (every certified own-message)
+and the per-peer unicast logs; the HELLO handshake streams these logs to a
+connecting peer (reference core/message-handling.go:316-350).
+
+Wake-ups are synchronous event sets on append (all protocol code runs on
+one loop — the asyncio analogue of the reference's per-replica goroutine
+ownership); idle streams park on an Event instead of polling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, List, Optional
+
+
+class MessageLog:
+    def __init__(self):
+        self._entries: List[object] = []
+        self._waiters: List[asyncio.Event] = []
+
+    def append(self, msg) -> None:
+        """Non-blocking append (reference messagelog.go:60-72)."""
+        self._entries.append(msg)
+        waiters, self._waiters = self._waiters, []
+        for ev in waiters:
+            ev.set()
+
+    def snapshot(self) -> List[object]:
+        return list(self._entries)
+
+    async def stream(
+        self, done: Optional[asyncio.Event] = None
+    ) -> AsyncIterator[object]:
+        """Replay all entries, then follow new ones (reference
+        messagelog.go:74-109).  Terminates when ``done`` is set."""
+        idx = 0
+        while True:
+            while idx < len(self._entries):
+                yield self._entries[idx]
+                idx += 1
+            if done is not None and done.is_set():
+                return
+            ev = asyncio.Event()
+            self._waiters.append(ev)
+            if idx < len(self._entries):
+                # An append raced our registration; the event may stay set
+                # or unset — loop and drain either way.
+                continue
+            if done is None:
+                await ev.wait()
+            else:
+                ev_task = asyncio.ensure_future(ev.wait())
+                done_task = asyncio.ensure_future(done.wait())
+                try:
+                    await asyncio.wait(
+                        [ev_task, done_task],
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                finally:
+                    ev_task.cancel()
+                    done_task.cancel()

@@ -1,0 +1,61 @@
+"""Per-peer UI-counter capture: the protocol's sequencing backbone.
+
+Reference core/internal/peerstate/peerstate.go:63-109: each peer's certified
+messages must be processed **exactly once, in counter order**.  ``capture_ui``
+returns False for an already-captured (replayed) counter value; if the
+counter is ahead of the next expected value, it *waits* until the gap closes
+(the reference blocks on a condvar).  ``release_ui`` is not needed —
+capture itself advances the sequence exactly as the reference's
+combined capture does when processing is strictly ordered; we keep the
+two-phase capture/release shape anyway so a failed processing attempt can
+retreat (reference returns a release closure).
+
+Batching interplay: *verification* of a UI happens **before** capture
+(stateless, batched on TPU); capture/processing stays sequential per peer.
+This is the ordering-vs-batching resolution from SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict
+
+
+class PeerState:
+    def __init__(self):
+        self._next_cv = 1  # USIG counters start at 1
+        self._cond = asyncio.Condition()
+
+    async def capture_ui(self, cv: int) -> bool:
+        """True once ``cv`` is ours to process (in order); False if ``cv``
+        was already captured (duplicate/replayed message)."""
+        async with self._cond:
+            while cv > self._next_cv:
+                await self._cond.wait()
+            if cv < self._next_cv:
+                return False
+            self._next_cv += 1
+            self._cond.notify_all()
+            return True
+
+    async def retreat_ui(self, cv: int) -> None:
+        """Undo a capture after failed processing (rare; keeps the
+        exactly-once promise intact for a retry)."""
+        async with self._cond:
+            if cv == self._next_cv - 1:
+                self._next_cv = cv
+            self._cond.notify_all()
+
+
+class PeerStates:
+    """Lazily-populated per-peer map (reference peerstate.go Provider)."""
+
+    def __init__(self):
+        self._peers: Dict[int, PeerState] = {}
+
+    def peer(self, replica_id: int) -> PeerState:
+        st = self._peers.get(replica_id)
+        if st is None:
+            st = PeerState()
+            self._peers[replica_id] = st
+        return st

@@ -1,0 +1,27 @@
+"""Pending-request set, one slot per client (reference
+core/internal/requestlist/request-list.go:36-80)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class RequestList:
+    def __init__(self):
+        self._by_client: Dict[int, object] = {}
+
+    def add(self, request) -> None:
+        self._by_client[request.client_id] = request
+
+    def remove(self, request) -> bool:
+        cur = self._by_client.get(request.client_id)
+        if cur is not None and cur.seq == request.seq:
+            del self._by_client[request.client_id]
+            return True
+        return False
+
+    def all(self) -> List[object]:
+        return list(self._by_client.values())
+
+    def __len__(self) -> int:
+        return len(self._by_client)

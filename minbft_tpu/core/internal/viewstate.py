@@ -1,0 +1,49 @@
+"""View number state (reference core/internal/viewstate/view-state.go:50-105).
+
+Tracks the current and expected view under an async RW-style discipline:
+``hold_view`` is the read-lease used by message processing (the reference
+takes a read lock and returns a release closure), ``advance_expected_view``
+/ ``advance_current_view`` move the view-change machinery forward.  View
+change processing itself is a stub in the reference (core/message-
+handling.go:419 "Not implemented"), so only the demand/advance edges are
+exercised here too.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Tuple
+
+
+class ViewState:
+    def __init__(self):
+        self._current = 0
+        self._expected = 0
+        self._lock = asyncio.Lock()
+
+    async def hold_view(self) -> Tuple[int, int]:
+        """-> (current_view, expected_view) snapshot.
+
+        The asyncio engine processes view-sensitive steps on one loop, so a
+        snapshot (not a held lock) is sufficient; mutators are serialized
+        with the internal lock."""
+        async with self._lock:
+            return self._current, self._expected
+
+    async def advance_expected_view(self, view: int) -> bool:
+        """Demand a view change to ``view``; False if not ahead
+        (reference view-state.go:74-88)."""
+        async with self._lock:
+            if view <= self._expected:
+                return False
+            self._expected = view
+            return True
+
+    async def advance_current_view(self, view: int) -> bool:
+        """Enter ``view`` (completes a view change; reference
+        view-state.go:90-105)."""
+        async with self._lock:
+            if view <= self._current or view > self._expected:
+                return False
+            self._current = view
+            return True

@@ -1,0 +1,466 @@
+"""Message handling: the processing graph and stream pumps.
+
+Reference core/message-handling.go — ``defaultMessageHandlers`` builds a
+~30-closure processing graph; here :func:`build_handlers` wires the same
+pipeline stages (validate → process → apply, with the generated-message
+path assigning UIs under a lock and fanning out through the message log).
+
+Asyncio re-design notes:
+
+- Each connection is a pair of async streams instead of goroutine pairs
+  (reference makeMessageStreamHandler, startPeerConnection).
+- **Validation awaits batched TPU verification** (the reference's serial
+  validate-then-process at message-handling.go:363-377 becomes
+  submit-batch-then-resolve): concurrent validations of different messages
+  coalesce in the :class:`minbft_tpu.parallel.BatchVerifier`.
+- Stateful processing (UI capture, seq capture, quorum accounting) stays
+  sequential per peer/client exactly as the reference's condvar-guarded
+  state packages require — batching never reorders *effects*.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Dict, Optional
+
+from .. import api
+from ..messages import (
+    Commit,
+    Hello,
+    Message,
+    Prepare,
+    ReqViewChange,
+    Reply,
+    Request,
+    authen_bytes,
+    marshal,
+    stringify,
+    unmarshal,
+)
+from . import commit as commit_mod
+from . import prepare as prepare_mod
+from . import request as request_mod
+from . import timeout as timeout_mod
+from . import usig_ui, utils
+from .internal.clientstate import ClientStates
+from .internal.messagelog import MessageLog
+from .internal.peerstate import PeerStates
+from .internal.requestlist import RequestList
+from .internal.viewstate import ViewState
+
+
+class Handlers:
+    """The wired processing graph (what ``defaultMessageHandlers`` returns,
+    reference core/message-handling.go:128-200)."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        n: int,
+        f: int,
+        configer: api.Configer,
+        authenticator: api.Authenticator,
+        consumer: api.RequestConsumer,
+        message_log: MessageLog,
+        unicast_logs: Dict[int, MessageLog],
+        client_states: ClientStates,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.replica_id = replica_id
+        self.n = n
+        self.f = f
+        self.configer = configer
+        self.authenticator = authenticator
+        self.log = logger or utils.make_logger(replica_id)
+        self.message_log = message_log
+        self.unicast_logs = unicast_logs
+        self.client_states = client_states
+        self.peer_states = PeerStates()
+        self.view_state = ViewState()
+        self.pending = RequestList()
+        self._ui_lock = asyncio.Lock()
+
+        # --- signing / verification primitives
+        def sign_message(msg) -> None:
+            msg.signature = authenticator.generate_message_authen_tag(
+                utils.signing_role(msg), authen_bytes(msg)
+            )
+
+        async def verify_signature(msg) -> None:
+            peer = msg.client_id if isinstance(msg, Request) else msg.replica_id
+            await authenticator.verify_message_authen_tag(
+                utils.signing_role(msg), peer, authen_bytes(msg), msg.signature
+            )
+
+        self.sign_message = sign_message
+        self.verify_signature = verify_signature
+        self.verify_ui = usig_ui.make_ui_verifier(authenticator)
+        self.assign_ui = usig_ui.make_ui_assigner(authenticator)
+        self.capture_ui = usig_ui.make_ui_capturer(self.peer_states)
+
+        # --- timers & view change
+        self.request_view_change = timeout_mod.make_view_change_requestor(
+            replica_id, self.view_state, sign_message, self._broadcast_signed
+        )
+        self.handle_request_timeout = timeout_mod.make_request_timeout_handler(
+            self.request_view_change
+        )
+
+        def start_request_timer(req: Request, view: int) -> None:
+            timeout = configer.timeout_request
+
+            def on_expiry() -> None:
+                self.log.warning(
+                    "request timeout for client %d seq %d", req.client_id, req.seq
+                )
+                asyncio.get_event_loop().create_task(
+                    self.handle_request_timeout(view)
+                )
+
+            self.client_states.client(req.client_id).start_request_timer(
+                timeout, on_expiry
+            )
+
+        def start_prepare_timer(req: Request, view: int) -> None:
+            timeout = configer.timeout_prepare
+
+            def on_expiry() -> None:
+                # Forward the starved request to the primary
+                # (reference core/request.go:315-324).
+                primary = view % n
+                self.log.info(
+                    "prepare timeout: forwarding request to primary %d", primary
+                )
+                ulog = self.unicast_logs.get(primary)
+                if ulog is not None:
+                    ulog.append(req)
+
+            self.client_states.client(req.client_id).start_prepare_timer(
+                timeout, on_expiry
+            )
+
+        def stop_timers(req: Request) -> None:
+            st = self.client_states.client(req.client_id)
+            st.stop_request_timer()
+            st.stop_prepare_timer()
+
+        def stop_prepare_timer(req: Request) -> None:
+            self.client_states.client(req.client_id).stop_prepare_timer()
+
+        # --- request pipeline
+        self.validate_request = request_mod.make_request_validator(verify_signature)
+        capture_seq = request_mod.make_seq_capturer(self.client_states)
+        self.release_seq = request_mod.make_seq_releaser(self.client_states)
+        prepare_seq = request_mod.make_seq_preparer(self.client_states)
+        retire_seq = request_mod.make_seq_retirer(self.client_states)
+
+        def add_reply(reply: Reply) -> None:
+            self.client_states.client(reply.client_id).add_reply(reply.seq, reply)
+
+        self.execute_request = request_mod.make_request_executor(
+            replica_id,
+            retire_seq,
+            self.pending,
+            stop_timers,
+            consumer,
+            sign_message,
+            add_reply,
+        )
+
+        def new_prepare(view: int, req: Request) -> Prepare:
+            return Prepare(replica_id=replica_id, view=view, request=req)
+
+        self.apply_request = request_mod.make_request_applier(
+            replica_id,
+            n,
+            self.handle_generated,
+            new_prepare,
+            start_prepare_timer,
+            start_request_timer,
+        )
+
+        async def _process_request_apply(req: Request, view: int) -> None:
+            try:
+                await self.apply_request(req, view)
+            finally:
+                await self.release_seq(req)
+
+        self.process_request = request_mod.make_request_processor(
+            capture_seq, self.pending, self.view_state, _process_request_apply
+        )
+
+        # --- commit pipeline / quorum
+        self.collect_commitment = commit_mod.make_commitment_collector(
+            f, self.execute_request
+        )
+        self.apply_commit = commit_mod.make_commit_applier(self.collect_commitment)
+
+        # --- prepare pipeline
+        self.apply_prepare = prepare_mod.make_prepare_applier(
+            replica_id,
+            prepare_seq,
+            self.collect_commitment,
+            self.handle_generated,
+            stop_prepare_timer,
+        )
+        self.validate_prepare = prepare_mod.make_prepare_validator(
+            n, self.validate_request, self.verify_ui
+        )
+        self.validate_commit = commit_mod.make_commit_validator(
+            n, self.validate_prepare, self.verify_ui
+        )
+
+        self.reply_request = request_mod.make_request_replier(self.client_states)
+
+    # ------------------------------------------------------------------
+    # Generated own messages (reference makeGeneratedMessageHandler /
+    # makeGeneratedMessageConsumer, core/message-handling.go:552-587).
+
+    async def handle_generated(self, msg: Message) -> None:
+        """Assign a UI under the global UI lock (serialized — USIG counters
+        must match log order) and append to the broadcast log."""
+        async with self._ui_lock:
+            if isinstance(msg, (Prepare, Commit)):
+                self.assign_ui(msg)
+            self.message_log.append(msg)
+
+    def _broadcast_signed(self, msg: Message) -> None:
+        """Broadcast a signed (non-certified) own message."""
+        self.message_log.append(msg)
+
+    # ------------------------------------------------------------------
+    # Validation dispatch (reference validateMessage,
+    # core/message-handling.go:409-424).
+
+    async def validate_message(self, msg: Message) -> None:
+        if isinstance(msg, Request):
+            await self.validate_request(msg)
+        elif isinstance(msg, Prepare):
+            await self.validate_prepare(msg)
+        elif isinstance(msg, Commit):
+            await self.validate_commit(msg)
+        elif isinstance(msg, ReqViewChange):
+            await self.verify_signature(msg)
+        else:
+            raise api.AuthenticationError(f"unexpected message {stringify(msg)}")
+
+    # ------------------------------------------------------------------
+    # Processing dispatch (reference processMessage / processPeerMessage /
+    # processViewMessage, core/message-handling.go:426-533).
+
+    async def process_message(self, msg: Message) -> bool:
+        if isinstance(msg, Request):
+            return await self.process_request(msg)
+        if isinstance(msg, (Prepare, Commit)):
+            return await self._process_peer_message(msg)
+        if isinstance(msg, ReqViewChange):
+            # Reference refuses: "Not implemented"
+            # (core/message-handling.go:419).
+            self.log.warning(
+                "view change processing not implemented: %s", stringify(msg)
+            )
+            return False
+        raise ValueError(f"unexpected message {stringify(msg)}")
+
+    async def _process_peer_message(self, msg) -> bool:
+        # Process embedded messages first (reference processEmbedded,
+        # core/message-handling.go:454-473).
+        if isinstance(msg, Prepare):
+            await self.process_request(msg.request)
+        elif isinstance(msg, Commit):
+            await self._process_peer_message(msg.prepare)
+
+        if not await self.capture_ui(msg):
+            return False  # already processed (replay)
+
+        # View check (reference processViewMessage,
+        # core/message-handling.go:492-533).
+        view, _ = await self.view_state.hold_view()
+        msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
+        if msg_view != view:
+            return False
+
+        if isinstance(msg, Prepare):
+            await self.apply_prepare(msg)
+        else:
+            await self.apply_commit(msg)
+        return True
+
+    # ------------------------------------------------------------------
+    # Top-level handlers (reference handleClientMessage / handlePeerMessage /
+    # handleOwnMessage, core/message-handling.go:352-403).
+
+    async def handle_client_message(self, msg: Message) -> Reply:
+        if not isinstance(msg, Request):
+            raise api.AuthenticationError("client stream accepts only REQUEST")
+        await self.validate_message(msg)
+        await self.process_message(msg)
+        # Reply once executed (even to a duplicate request — the client may
+        # be retrying a lost reply, reference message-handling.go:396-403).
+        return await self.reply_request(msg)
+
+    async def handle_peer_message(self, msg: Message) -> None:
+        if isinstance(msg, (Prepare, Commit, ReqViewChange, Request)):
+            await self.validate_message(msg)
+            await self.process_message(msg)
+        else:
+            raise api.AuthenticationError(
+                f"unexpected peer message {stringify(msg)}"
+            )
+
+    async def handle_own_message(self, msg: Message) -> None:
+        """Own messages replayed from the log are trusted — no validation
+        (reference handleOwnMessage, core/message-handling.go:352-361)."""
+        if isinstance(msg, (Prepare, Commit)):
+            await self._process_peer_message(msg)
+
+
+# ---------------------------------------------------------------------------
+# Stream pumps.
+
+
+class PeerStreamHandler(api.MessageStreamHandler):
+    """Server side of a peer connection: expect HELLO, then stream the
+    broadcast log + the hello sender's unicast log
+    (reference makeHelloHandler, core/message-handling.go:316-350)."""
+
+    def __init__(self, handlers: Handlers):
+        self.handlers = handlers
+
+    async def handle_message_stream(
+        self, in_stream: AsyncIterator[bytes]
+    ) -> AsyncIterator[bytes]:
+        first = await _anext(in_stream)
+        if first is None:
+            return
+        hello = unmarshal(first)
+        if not isinstance(hello, Hello):
+            raise api.AuthenticationError("peer stream must start with HELLO")
+        peer_id = hello.replica_id
+        h = self.handlers
+
+        queue: asyncio.Queue = asyncio.Queue()
+        done = asyncio.Event()
+
+        async def pump(log: MessageLog) -> None:
+            async for msg in log.stream(done):
+                await queue.put(msg)
+
+        tasks = [asyncio.get_event_loop().create_task(pump(h.message_log))]
+        ulog = h.unicast_logs.get(peer_id)
+        if ulog is not None:
+            tasks.append(asyncio.get_event_loop().create_task(pump(ulog)))
+
+        # Also consume (and process) any further messages the peer sends on
+        # this stream (the reference's separate incoming direction).
+        async def consume_incoming() -> None:
+            async for data in in_stream:
+                try:
+                    msg = unmarshal(data)
+                    await h.handle_peer_message(msg)
+                except Exception as e:  # drop invalid peer messages
+                    h.log.warning("dropping peer message: %s", e)
+
+        tasks.append(asyncio.get_event_loop().create_task(consume_incoming()))
+
+        try:
+            while True:
+                msg = await queue.get()
+                yield marshal(msg)
+        finally:
+            done.set()
+            for t in tasks:
+                t.cancel()
+
+
+class ClientStreamHandler(api.MessageStreamHandler):
+    """Server side of a client connection: REQUESTs in, REPLYs out
+    (reference ClientMessageStreamHandler, core/replica.go:97-104)."""
+
+    def __init__(self, handlers: Handlers):
+        self.handlers = handlers
+
+    async def handle_message_stream(
+        self, in_stream: AsyncIterator[bytes]
+    ) -> AsyncIterator[bytes]:
+        h = self.handlers
+        out_queue: asyncio.Queue = asyncio.Queue()
+        FIN = object()
+
+        async def handle_one(data: bytes) -> None:
+            try:
+                msg = unmarshal(data)
+                reply = await h.handle_client_message(msg)
+                await out_queue.put(marshal(reply))
+            except Exception as e:
+                h.log.warning("dropping client message: %s", e)
+
+        async def consume() -> None:
+            tasks = []
+            async for data in in_stream:
+                # Requests are handled concurrently: replies may take a
+                # quorum round-trip each, and a client may pipeline
+                # requests for different clients over one stream.
+                tasks.append(asyncio.get_event_loop().create_task(handle_one(data)))
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+            await out_queue.put(FIN)
+
+        consumer_task = asyncio.get_event_loop().create_task(consume())
+        try:
+            while True:
+                item = await out_queue.get()
+                if item is FIN:
+                    break
+                yield item
+        finally:
+            consumer_task.cancel()
+
+
+async def _anext(ait: AsyncIterator[bytes]) -> Optional[bytes]:
+    try:
+        return await ait.__anext__()
+    except StopAsyncIteration:
+        return None
+
+
+async def run_own_message_loop(handlers: Handlers, done: asyncio.Event) -> None:
+    """Self-delivery of own generated messages (reference
+    handleOwnPeerMessages, core/message-handling.go:294-302): this is how
+    the primary counts its own PREPARE and a backup its own COMMIT."""
+    async for msg in handlers.message_log.stream(done):
+        try:
+            await handlers.handle_own_message(msg)
+        except Exception:
+            handlers.log.exception("own-message processing failed")
+
+
+async def run_peer_connection(
+    handlers: Handlers,
+    peer_id: int,
+    stream_handler: api.MessageStreamHandler,
+    done: asyncio.Event,
+) -> None:
+    """Client side of a peer connection: send HELLO, process the peer's
+    reply stream (reference startPeerConnection,
+    core/message-handling.go:269-290)."""
+
+    async def outgoing() -> AsyncIterator[bytes]:
+        yield marshal(Hello(replica_id=handlers.replica_id))
+        # Keep the stream open until shutdown.
+        await done.wait()
+
+    try:
+        async for data in stream_handler.handle_message_stream(outgoing()):
+            if done.is_set():
+                break
+            try:
+                msg = unmarshal(data)
+                await handlers.handle_peer_message(msg)
+            except api.AuthenticationError as e:
+                handlers.log.warning("peer %d message rejected: %s", peer_id, e)
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        handlers.log.exception("peer %d connection failed", peer_id)

@@ -1,0 +1,61 @@
+"""Prepare pipeline (reference core/prepare.go).
+
+Only the primary of the PREPARE's view may have produced it (reference
+prepare.go:51-53); validation re-checks the embedded REQUEST's client
+signature and the primary's UI — with the TPU authenticator, both checks
+join the same verification batch via ``asyncio.gather``.  Applying a
+PREPARE on a backup marks the request prepared, collects the primary's
+commitment, and responds with an own COMMIT (reference prepare.go:69-94).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+from .. import api
+from ..messages import Commit, Prepare
+from . import utils
+
+
+def make_prepare_validator(
+    n: int,
+    validate_request,
+    verify_ui,
+) -> Callable[[Prepare], Awaitable[None]]:
+    """Reference makePrepareValidator (core/prepare.go:46-65)."""
+
+    async def validate_prepare(prepare: Prepare) -> None:
+        if not utils.is_primary(prepare.view, prepare.replica_id, n):
+            raise api.AuthenticationError(
+                f"PREPARE from non-primary replica {prepare.replica_id} "
+                f"in view {prepare.view}"
+            )
+        # Client signature on the embedded request + primary's UI, batched
+        # together (the reference does these serially, prepare.go:55-61).
+        await asyncio.gather(
+            validate_request(prepare.request), verify_ui(prepare)
+        )
+
+    return validate_prepare
+
+
+def make_prepare_applier(
+    replica_id: int,
+    prepare_seq,
+    collect_commitment,
+    handle_generated,
+    stop_prepare_timer,
+) -> Callable[[Prepare], Awaitable[None]]:
+    """Reference makePrepareApplier (core/prepare.go:69-94)."""
+
+    async def apply_prepare(prepare: Prepare) -> None:
+        prepare_seq(prepare.request)
+        stop_prepare_timer(prepare.request)
+        await collect_commitment(prepare.replica_id, prepare)
+        if prepare.replica_id != replica_id:
+            # A backup commits to the accepted proposal
+            # (reference prepare.go:90 NewCommit).
+            await handle_generated(Commit(replica_id=replica_id, prepare=prepare))
+
+    return apply_prepare

@@ -1,0 +1,116 @@
+"""Replica assembly (reference core/replica.go:50-104).
+
+``new_replica`` validates n >= 2f+1, builds the message log and per-peer
+unicast logs, wires the handler graph, and returns an :class:`api.Replica`
+whose ``start`` opens peer connections and launches the own-message loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from .. import api
+from . import message_handling
+from .internal.clientstate import ClientStates
+from .internal.messagelog import MessageLog
+from .internal.timer import TimerProvider
+from .utils import make_logger
+
+
+class Stack(api.Authenticator, api.ReplicaConnector, api.RequestConsumer):
+    """The external-modules union the core consumes
+    (reference core/replica.go:37-41)."""
+
+
+class _Replica(api.Replica):
+    def __init__(
+        self,
+        replica_id: int,
+        configer: api.Configer,
+        authenticator: api.Authenticator,
+        connector: api.ReplicaConnector,
+        consumer: api.RequestConsumer,
+        timer_provider: Optional[TimerProvider] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        n, f = configer.n, configer.f
+        if n < 2 * f + 1:
+            # reference core/replica.go:54-56
+            raise ValueError(f"n must be at least 2f+1 (n={n}, f={f})")
+        if not 0 <= replica_id < n:
+            raise ValueError(f"replica id {replica_id} out of range for n={n}")
+        self.id = replica_id
+        self.n = n
+        self.f = f
+        self._connector = connector
+        self._done = asyncio.Event()
+        self._tasks: list = []
+
+        message_log = MessageLog()
+        unicast_logs: Dict[int, MessageLog] = {
+            p: MessageLog() for p in range(n) if p != replica_id
+        }
+        client_states = ClientStates(timer_provider)
+        self.handlers = message_handling.Handlers(
+            replica_id,
+            n,
+            f,
+            configer,
+            authenticator,
+            consumer,
+            message_log,
+            unicast_logs,
+            client_states,
+            logger or make_logger(replica_id),
+        )
+
+    def peer_message_stream_handler(self) -> api.MessageStreamHandler:
+        return message_handling.PeerStreamHandler(self.handlers)
+
+    def client_message_stream_handler(self) -> api.MessageStreamHandler:
+        return message_handling.ClientStreamHandler(self.handlers)
+
+    async def start(self) -> None:
+        loop = asyncio.get_event_loop()
+        self._tasks.append(
+            loop.create_task(
+                message_handling.run_own_message_loop(self.handlers, self._done)
+            )
+        )
+        for peer in range(self.n):
+            if peer == self.id:
+                continue
+            sh = self._connector.replica_message_stream_handler(peer)
+            if sh is None:
+                raise ValueError(f"no connection for peer {peer}")
+            self._tasks.append(
+                loop.create_task(
+                    message_handling.run_peer_connection(
+                        self.handlers, peer, sh, self._done
+                    )
+                )
+            )
+
+    async def stop(self) -> None:
+        self._done.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+
+
+def new_replica(
+    replica_id: int,
+    configer: api.Configer,
+    authenticator: api.Authenticator,
+    connector: api.ReplicaConnector,
+    consumer: api.RequestConsumer,
+    timer_provider: Optional[TimerProvider] = None,
+    logger: Optional[logging.Logger] = None,
+) -> api.Replica:
+    """Create a replica (reference minbft.New, core/replica.go:50)."""
+    return _Replica(
+        replica_id, configer, authenticator, connector, consumer, timer_provider, logger
+    )

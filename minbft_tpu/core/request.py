@@ -1,0 +1,154 @@
+"""Request pipeline: validate → process → apply → execute → reply.
+
+Reference core/request.go: the client's REQUEST is signature-checked, its
+sequence number captured per-client (dedup + one-in-flight pipelining gate),
+tracked in the pending list; the primary then emits a PREPARE while backups
+start a prepare timer; on quorum the request is executed against the
+consumer and a signed REPLY is produced.
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable
+
+from .. import api
+from ..messages import Reply, Request, authen_bytes
+from . import utils
+
+
+def make_request_validator(
+    verify_message_signature,
+) -> Callable[[Request], Awaitable[None]]:
+    """Stateless REQUEST validation (reference makeRequestValidator,
+    core/request.go:146-150): just the client signature."""
+
+    async def validate_request(request: Request) -> None:
+        await verify_message_signature(request)
+
+    return validate_request
+
+
+def make_request_processor(
+    capture_seq,
+    pending_requests,
+    view_state,
+    apply_request,
+) -> Callable[[Request], Awaitable[bool]]:
+    """Stateful REQUEST processing (reference makeRequestProcessor,
+    core/request.go:155-178): capture seq (False = duplicate), track
+    pending, snapshot the view, apply."""
+
+    async def process_request(request: Request) -> bool:
+        new = await capture_seq(request)
+        if not new:
+            return False
+        pending_requests.add(request)
+        view, _ = await view_state.hold_view()
+        await apply_request(request, view)
+        return True
+
+    return process_request
+
+
+def make_request_applier(
+    replica_id: int,
+    n: int,
+    handle_generated,
+    new_prepare,
+    start_prepare_timer,
+    start_request_timer,
+) -> Callable[[Request, int], Awaitable[None]]:
+    """Apply a captured REQUEST in a view (reference makeRequestApplier,
+    core/request.go:180-198): the primary proposes a PREPARE; a backup
+    starts the prepare timer (forward-to-primary fallback) — both start
+    the request (view-change) timer."""
+
+    async def apply_request(request: Request, view: int) -> None:
+        start_request_timer(request, view)
+        if utils.is_primary(view, replica_id, n):
+            await handle_generated(new_prepare(view, request))
+        else:
+            start_prepare_timer(request, view)
+
+    return apply_request
+
+
+def make_request_executor(
+    replica_id: int,
+    retire_seq,
+    pending_requests,
+    stop_timers,
+    consumer: api.RequestConsumer,
+    sign_message,
+    add_reply,
+) -> Callable[[Request], Awaitable[None]]:
+    """Execute a committed REQUEST exactly once (reference
+    makeRequestExecutor, core/request.go:211-231): retire the seq (dedup),
+    clear timers and pending state, deliver to the state machine, sign and
+    buffer the REPLY."""
+
+    async def execute_request(request: Request) -> None:
+        if not retire_seq(request):
+            return  # already executed (reference request.go:214-218)
+        pending_requests.remove(request)
+        stop_timers(request)
+        result = await consumer.deliver(request.operation)
+        reply = Reply(
+            replica_id=replica_id,
+            client_id=request.client_id,
+            seq=request.seq,
+            result=result,
+        )
+        sign_message(reply)
+        add_reply(reply)
+
+    return execute_request
+
+
+def make_request_replier(
+    client_states,
+) -> Callable[[Request], Awaitable[Reply]]:
+    """Await the REPLY for a REQUEST (reference makeRequestReplier,
+    core/request.go:202-207 → clientstate reply subscription)."""
+
+    async def reply_request(request: Request) -> Reply:
+        return await client_states.client(request.client_id).reply_for(request.seq)
+
+    return reply_request
+
+
+def make_seq_capturer(client_states) -> Callable[[Request], Awaitable[bool]]:
+    """Per-client seq capture (reference captureSeq, core/request.go:235-246)."""
+
+    async def capture_seq(request: Request) -> bool:
+        return await client_states.client(request.client_id).capture_request_seq(
+            request.seq
+        )
+
+    return capture_seq
+
+
+def make_seq_releaser(client_states) -> Callable[[Request], Awaitable[None]]:
+    async def release_seq(request: Request) -> None:
+        await client_states.client(request.client_id).release_request_seq(request.seq)
+
+    return release_seq
+
+
+def make_seq_preparer(client_states) -> Callable[[Request], None]:
+    """Mark a request prepared (reference prepareSeq, core/request.go:248-259)."""
+
+    def prepare_seq(request: Request) -> None:
+        client_states.client(request.client_id).prepare_request_seq(request.seq)
+
+    return prepare_seq
+
+
+def make_seq_retirer(client_states) -> Callable[[Request], bool]:
+    """Retire an executed request's seq (reference retireSeq,
+    core/request.go:261-276)."""
+
+    def retire_seq(request: Request) -> bool:
+        return client_states.client(request.client_id).retire_request_seq(request.seq)
+
+    return retire_seq

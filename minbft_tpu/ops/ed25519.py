@@ -1,0 +1,249 @@
+"""Batched Ed25519 verification as a JAX/XLA TPU kernel.
+
+The Ed25519 authenticator path (BASELINE config[5]: n=31, batch=1024).
+Same architecture as :mod:`minbft_tpu.ops.p256` — host does the cheap
+irregular work, the device does the double-scalar multiplication over the
+shared limb machinery (:mod:`minbft_tpu.ops.limbs`) — but the curve shape
+is friendlier: twisted Edwards (a = -1) extended coordinates have
+**complete** addition formulas (a is a square mod 2^255-19, d is not), so
+the ladder needs *zero* exceptional-case handling: the identity is a
+perfectly ordinary table entry and add(P, P) just works.
+
+Cofactored verification (RFC 8032's recommended interpretation, matching
+:func:`minbft_tpu.utils.hostcrypto.ed25519_verify`): accept iff
+``8*S*B == 8*R + 8*k*A``.  Host computes k = SHA-512(R||A||M) mod L (SHA-512
+needs 64-bit ops — pointless to emulate on device for 96-byte inputs),
+decompresses A and R (one sqrt each, host big ints), negates A, and ships
+``u1 = 8S mod L``, ``u2 = 8k mod L``, ``A' = -A``, and ``R8 = 8R`` (affine).
+Device computes ``P = u1*B + u2*A'`` (256 doublings + 256 *unconditional*
+complete additions) and accepts iff ``P == R8`` projectively.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import limbs
+from .limbs import (
+    Fe,
+    FieldSpec,
+    add_mod,
+    fe_const,
+    fe_eq,
+    fe_from_array,
+    fe_select,
+    mont_mul,
+    mont_one,
+    mont_sqr,
+    sub_mod,
+    to_limbs,
+    to_mont,
+)
+from ..utils import hostcrypto as hc
+
+P = hc.ED_P  # 2^255 - 19
+L = hc.ED_L
+D = hc.ED_D
+
+FIELD = FieldSpec.make(P)
+
+_BX_M = fe_const((hc.ED_BX << 256) % P)
+_BY_M = fe_const((hc.ED_BY << 256) % P)
+_BT_M = fe_const(((hc.ED_BX * hc.ED_BY % P) << 256) % P)
+_D2_M = fe_const(((2 * D % P) << 256) % P)
+
+
+class EdPoint(NamedTuple):
+    """Extended twisted-Edwards point (X : Y : Z : T), Montgomery limbs."""
+
+    x: Fe
+    y: Fe
+    z: Fe
+    t: Fe
+
+
+def _identity() -> EdPoint:
+    one = mont_one(FIELD)
+    zero = limbs.fe_zero()
+    return EdPoint(zero, one, one, zero)
+
+
+def _add(p: EdPoint, q: EdPoint) -> EdPoint:
+    """Complete unified addition, a = -1 (add-2008-hwcd-3 with k = 2d).
+    Handles identity and doubling inputs exactly — no special cases."""
+    f = FIELD
+    a = mont_mul(f, sub_mod(f, p.y, p.x), sub_mod(f, q.y, q.x))
+    b = mont_mul(f, add_mod(f, p.y, p.x), add_mod(f, q.y, q.x))
+    c = mont_mul(f, mont_mul(f, p.t, _D2_M), q.t)
+    zz = mont_mul(f, p.z, q.z)
+    d = add_mod(f, zz, zz)
+    e = sub_mod(f, b, a)
+    ff = sub_mod(f, d, c)
+    g = add_mod(f, d, c)
+    h = add_mod(f, b, a)
+    return EdPoint(
+        mont_mul(f, e, ff),
+        mont_mul(f, g, h),
+        mont_mul(f, ff, g),
+        mont_mul(f, e, h),
+    )
+
+
+def _dbl(p: EdPoint) -> EdPoint:
+    """Dedicated doubling (dbl-2008-hwcd, a = -1): 4M + 4S."""
+    f = FIELD
+    a = mont_sqr(f, p.x)
+    b = mont_sqr(f, p.y)
+    zz = mont_sqr(f, p.z)
+    c = add_mod(f, zz, zz)
+    # a_curve = -1: D = -A
+    e = sub_mod(f, sub_mod(f, mont_sqr(f, add_mod(f, p.x, p.y)), a), b)
+    g = sub_mod(f, b, a)  # D + B
+    ff = sub_mod(f, g, c)
+    h = sub_mod(f, limbs.fe_zero(), add_mod(f, a, b))  # D - B = -(A+B)
+    return EdPoint(
+        mont_mul(f, e, ff),
+        mont_mul(f, g, h),
+        mont_mul(f, ff, g),
+        mont_mul(f, e, h),
+    )
+
+
+def _bits_of(scalar_arr: jnp.ndarray) -> jnp.ndarray:
+    shifts = jnp.arange(limbs.LIMB_BITS, dtype=jnp.uint32)
+    return ((scalar_arr[:, None] >> shifts[None, :]) & 1).reshape(256)
+
+
+def _ladder(u1_arr: jnp.ndarray, u2_arr: jnp.ndarray, aq: EdPoint) -> EdPoint:
+    """P = u1*B + u2*A' — interleaved ladder with *unconditional* complete
+    additions: table index 0 is the identity, so every iteration is
+    double-then-add with a 4-way table select and no branches at all."""
+    one = mont_one(FIELD)
+    zero = limbs.fe_zero()
+    bpt = EdPoint(_BX_M, _BY_M, one, _BT_M)
+    ba = _add(bpt, aq)  # B + A'
+
+    tab = [_identity(), aq, bpt, ba]  # index = 2*bit(u1) + bit(u2)
+    bits1 = _bits_of(u1_arr)
+    bits2 = _bits_of(u2_arr)
+
+    def sel(d, coord):
+        is1, is2 = d == 1, d == 2
+        return tuple(
+            jnp.where(
+                is1, t1, jnp.where(is2, t2, jnp.where(d == 3, t3, t0))
+            )
+            for t0, t1, t2, t3 in zip(*(getattr(t, coord) for t in tab))
+        )
+
+    def body(i, acc):
+        j = 255 - i
+        acc = _dbl(acc)
+        b1 = lax.dynamic_index_in_dim(bits1, j, keepdims=False)
+        b2 = lax.dynamic_index_in_dim(bits2, j, keepdims=False)
+        d = b1 * 2 + b2
+        addend = EdPoint(sel(d, "x"), sel(d, "y"), sel(d, "z"), sel(d, "t"))
+        return _add(acc, addend)
+
+    return lax.fori_loop(0, 256, body, _identity())
+
+
+def _verify_one(
+    ax: jnp.ndarray,
+    ay: jnp.ndarray,
+    u1: jnp.ndarray,
+    u2: jnp.ndarray,
+    r8x: jnp.ndarray,
+    r8y: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """Scalar-shaped Ed25519 verify core; limb-array args [16] u32.
+
+    Accepts iff u1*B + u2*A' == R8 (projective compare: X == x*Z and
+    Y == y*Z; Z is never 0 under complete formulas on curve points)."""
+    f = FIELD
+    ax_m = to_mont(f, fe_from_array(ax))
+    ay_m = to_mont(f, fe_from_array(ay))
+    at_m = mont_mul(f, ax_m, ay_m)
+    aq = EdPoint(ax_m, ay_m, mont_one(f), at_m)
+    res = _ladder(u1, u2, aq)
+    x8 = to_mont(f, fe_from_array(r8x))
+    y8 = to_mont(f, fe_from_array(r8y))
+    ok_x = fe_eq(res.x, mont_mul(f, x8, res.z))
+    ok_y = fe_eq(res.y, mont_mul(f, y8, res.z))
+    return ok_x & ok_y & valid
+
+
+ed25519_verify_kernel = jax.jit(jax.vmap(_verify_one))
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch preparation.
+
+
+def _to_affine_host(p) -> Tuple[int, int]:
+    x, y, z, _ = p
+    zi = pow(z, -1, P)
+    return x * zi % P, y * zi % P
+
+
+def prepare_batch(
+    items: Sequence[Tuple[bytes, bytes, bytes]], bucket: int
+) -> Tuple[np.ndarray, ...]:
+    """[(pub32, msg, sig64)] -> device-ready limb arrays, padded to
+    ``bucket`` lanes.  Malformed/non-canonical inputs get valid=False."""
+    import hashlib
+
+    b = bucket
+    ax = np.zeros((b, limbs.NLIMBS), np.uint32)
+    ay = np.zeros((b, limbs.NLIMBS), np.uint32)
+    u1 = np.zeros((b, limbs.NLIMBS), np.uint32)
+    u2 = np.zeros((b, limbs.NLIMBS), np.uint32)
+    r8x = np.zeros((b, limbs.NLIMBS), np.uint32)
+    r8y = np.zeros((b, limbs.NLIMBS), np.uint32)
+    valid = np.zeros((b,), np.bool_)
+    for i, (pub, msg, sig) in enumerate(items):
+        if len(sig) != 64:
+            continue
+        a_pt = hc.ed_decompress(pub)
+        r_pt = hc.ed_decompress(sig[:32])
+        if a_pt is None or r_pt is None:
+            continue
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L:
+            continue
+        k = (
+            int.from_bytes(
+                hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
+            )
+            % L
+        )
+        a_aff = _to_affine_host(a_pt)
+        r8 = _to_affine_host(hc.ed_scalar_mult(8, r_pt))
+        ax[i] = to_limbs((P - a_aff[0]) % P)  # A' = -A
+        ay[i] = to_limbs(a_aff[1])
+        u1[i] = to_limbs(8 * s % L)
+        u2[i] = to_limbs(8 * k % L)
+        r8x[i] = to_limbs(r8[0])
+        r8y[i] = to_limbs(r8[1])
+        valid[i] = True
+    return ax, ay, u1, u2, r8x, r8y, valid
+
+
+def verify_batch_padded(
+    items: Sequence[Tuple[bytes, bytes, bytes]], bucket: int
+) -> np.ndarray:
+    """Engine dispatch hook: prepare on host, verify on device -> [bucket]
+    bool (lanes past len(items) are padding)."""
+    arrays = prepare_batch(items, bucket)
+    return np.asarray(ed25519_verify_kernel(*[jnp.asarray(a) for a in arrays]))
+
+
+def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    return verify_batch_padded(items, len(items))[: len(items)]

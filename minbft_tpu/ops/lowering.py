@@ -1,0 +1,30 @@
+"""Lowering-mode dispatch shared by the crypto kernels.
+
+Every kernel in :mod:`minbft_tpu.ops` has two lowerings of the same
+arithmetic: a fully **unrolled** straight-line form (what TPUs want — Mosaic
+compiles it fast and fuses it completely) and a compact **loop** form
+(``lax.scan``/``fori_loop``) for the CPU "SIM mode" backend, where XLA's
+LLVM codegen is superlinear in basic-block size and chokes on big unrolled
+graphs.  Dispatch is by backend at trace time; ``set_mode`` forces one for
+equivalence tests.
+"""
+
+from __future__ import annotations
+
+_FORCE_MODE = None  # None = auto by backend | "unrolled" | "loop"
+
+
+def set_mode(mode) -> None:
+    """Force 'unrolled' or 'loop' lowering (None = auto: unrolled off-CPU)."""
+    global _FORCE_MODE
+    if mode not in (None, "unrolled", "loop"):
+        raise ValueError(mode)
+    _FORCE_MODE = mode
+
+
+def use_unrolled() -> bool:
+    if _FORCE_MODE is not None:
+        return _FORCE_MODE == "unrolled"
+    import jax
+
+    return jax.default_backend() != "cpu"

@@ -6,7 +6,7 @@ sample/authentication/crypto.go:79-89; enclave-side create at
 usig/sgx/enclave/usig.c:36-76, verification in pure Go at
 usig/sgx/sgx-usig.go:81-97).  Here a whole batch of verifications runs as one
 data-parallel XLA program: ``jax.vmap`` over a scalar-shaped verifier whose
-field arithmetic is the limb machinery of :mod:`minbft_tpu.ops.limbs`.
+field arithmetic is the fused limb machinery of :mod:`minbft_tpu.ops.limbs`.
 
 Division of labor (TPU-first):
 
@@ -16,19 +16,23 @@ Division of labor (TPU-first):
   cheap, and it keeps mod-n arithmetic off the device entirely.
 - **Device** does everything expensive: the 256-bit double-scalar
   multiplication ``u1*G + u2*Q`` (interleaved Shamir ladder, Jacobian
-  coordinates, a = -3 doubling), one Fermat inversion to build the G+Q table
-  entry, final affine conversion, and the ``x(R) ≡ r (mod n)`` check — all
+  coordinates, a = -3 doubling), one Fermat inversion to build the G+Q
+  table entry, and the affine-free final check ``X == r * Z^2`` — all
   constant-shape, batched, jit-compiled once per batch bucket.
 
-Exceptional cases (identity operands, P == ±Q mid-ladder) are handled with
-constant-shape selects, never data-dependent branches, so adversarial
-signatures cannot force a recompile or a trace divergence.
+Adversarial-input policy: the mixed-addition formula is incomplete (it
+cannot add a point to itself).  Instead of paying a full doubling inside
+every ladder add, the kernel *detects* the exceptional case and marks the
+lane rejected (``exc`` flag).  Honest signatures hit it with probability
+~2^-250; crafted signatures that steer the ladder into a collision are
+simply rejected, which is always sound — the kernel only ever errs toward
+rejection.  Identity operands (ladder start, Q == -G table entry) are
+handled exactly with constant-shape selects.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Sequence, Tuple
+from typing import NamedTuple, Sequence, Tuple
 
 import numpy as np
 
@@ -38,10 +42,15 @@ from jax import lax
 
 from . import limbs
 from .limbs import (
+    Fe,
     FieldSpec,
     add_mod,
+    fe_const,
+    fe_eq,
+    fe_from_array,
+    fe_is_zero,
+    fe_select,
     from_limbs,
-    limbs_eq,
     mont_inv,
     mont_mul,
     mont_one,
@@ -63,48 +72,48 @@ GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
 FIELD = FieldSpec.make(P)
 ORDER = FieldSpec.make(N)
 
-
-def _const_mont(x: int) -> np.ndarray:
-    """Host-side constant -> Montgomery-domain limbs (numpy, trace-time)."""
-    return to_limbs((x << 256) % P)
+_GX_M = fe_const((GX << 256) % P)  # Montgomery-domain constants
+_GY_M = fe_const((GY << 256) % P)
 
 
-_GX_M = _const_mont(GX)
-_GY_M = _const_mont(GY)
-_B_M = _const_mont(B)
+class Point(NamedTuple):
+    """Jacobian point, coordinates in Montgomery domain. Z == 0 <=> identity."""
 
-Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # Jacobian (X, Y, Z), Montgomery
+    x: Fe
+    y: Fe
+    z: Fe
 
 
 def _dbl(p: Point) -> Point:
     """Jacobian doubling, a = -3 (dbl-2001-b).  Maps identity to identity."""
-    x, y, z = p
     f = FIELD
-    delta = mont_sqr(f, z)
-    gamma = mont_sqr(f, y)
-    beta = mont_mul(f, x, gamma)
-    t0 = sub_mod(f, x, delta)
-    t1 = add_mod(f, x, delta)
+    delta = mont_sqr(f, p.z)
+    gamma = mont_sqr(f, p.y)
+    beta = mont_mul(f, p.x, gamma)
+    t0 = sub_mod(f, p.x, delta)
+    t1 = add_mod(f, p.x, delta)
     alpha = mont_mul(f, add_mod(f, add_mod(f, t0, t0), t0), t1)  # 3(x-d)(x+d)
     beta4 = add_mod(f, add_mod(f, beta, beta), add_mod(f, beta, beta))
     beta8 = add_mod(f, beta4, beta4)
     x3 = sub_mod(f, mont_sqr(f, alpha), beta8)
-    yz = add_mod(f, y, z)
+    yz = add_mod(f, p.y, p.z)
     z3 = sub_mod(f, sub_mod(f, mont_sqr(f, yz), gamma), delta)
     g2 = mont_sqr(f, gamma)
     g8 = add_mod(f, add_mod(f, g2, g2), add_mod(f, g2, g2))
     g8 = add_mod(f, g8, g8)
     y3 = sub_mod(f, mont_mul(f, alpha, sub_mod(f, beta4, x3)), g8)
-    return x3, y3, z3
+    return Point(x3, y3, z3)
 
 
-def _madd(p: Point, qx: jnp.ndarray, qy: jnp.ndarray, q_inf: jnp.ndarray) -> Point:
-    """Mixed Jacobian + affine addition with full exceptional-case handling.
+def _madd(
+    p: Point, qx: Fe, qy: Fe, q_inf: jnp.ndarray
+) -> Tuple[Point, jnp.ndarray]:
+    """Mixed Jacobian + affine addition (madd, 8M+3S).
 
-    q_inf: bool — the affine operand is the identity (then result = p).
-    If p is the identity -> (qx, qy, 1).  If p == q -> doubling.  If
-    p == -q -> identity (falls out of the formula with H = 0, r != 0).
-    All cases resolved via constant-shape selects.
+    Returns (result, exc) where ``exc`` flags the formula's undefined case
+    p == q (same x, same y, both finite) — callers must reject the lane.
+    p == -q falls out correctly as the identity (Z3 = Z1*H = 0); identity
+    operands are resolved by selects.
     """
     x1, y1, z1 = p
     f = FIELD
@@ -120,94 +129,86 @@ def _madd(p: Point, qx: jnp.ndarray, qy: jnp.ndarray, q_inf: jnp.ndarray) -> Poi
     y3 = sub_mod(f, mont_mul(f, r, sub_mod(f, v, x3)), mont_mul(f, y1, hhh))
     z3 = mont_mul(f, z1, h)
 
-    p_inf = limbs.is_zero(z1)
-    same_x = limbs.is_zero(h)
-    same_y = limbs.is_zero(r)
-    dblx, dbly, dblz = _dbl(p)
+    p_inf = fe_is_zero(z1)
+    exc = fe_is_zero(h) & fe_is_zero(r) & ~p_inf & ~q_inf
 
     one = mont_one(f)
-
-    def sel(c, a, b):
-        return jnp.where(c, a, b)
-
-    # doubling case (p == q)
-    use_dbl = jnp.logical_and(same_x, same_y) & ~p_inf & ~q_inf
-    x3 = sel(use_dbl, dblx, x3)
-    y3 = sel(use_dbl, dbly, y3)
-    z3 = sel(use_dbl, dblz, z3)
-    # p is identity -> q
-    x3 = sel(p_inf, qx, x3)
-    y3 = sel(p_inf, qy, y3)
-    z3 = sel(p_inf, sel(q_inf, jnp.zeros_like(one), one), z3)
-    # q is identity -> p
-    x3 = sel(q_inf & ~p_inf, x1, x3)
-    y3 = sel(q_inf & ~p_inf, y1, y3)
-    z3 = sel(q_inf & ~p_inf, z1, z3)
-    return x3, y3, z3
+    zero = limbs.fe_zero()
+    # p identity -> q (affine lift); q identity -> p; both -> identity.
+    x3 = fe_select(p_inf, qx, fe_select(q_inf, x1, x3))
+    y3 = fe_select(p_inf, qy, fe_select(q_inf, y1, y3))
+    z3 = fe_select(
+        p_inf, fe_select(q_inf, zero, one), fe_select(q_inf, z1, z3)
+    )
+    return Point(x3, y3, z3), exc
 
 
-def _to_affine(p: Point) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Jacobian Montgomery -> affine *normal-domain* (x, y), plus inf flag."""
-    x, y, z = p
-    f = FIELD
-    inf = limbs.is_zero(z)
-    zsafe = jnp.where(inf, mont_one(f), z)
-    zi = mont_inv(f, zsafe)
-    zi2 = mont_sqr(f, zi)
-    ax = mont_mul(f, x, zi2)
-    ay = mont_mul(f, y, mont_mul(f, zi, zi2))
-    return limbs.from_mont(f, ax), limbs.from_mont(f, ay), inf
+def _madd_complete_table(p: Point, qx: Fe, qy: Fe, q_inf: jnp.ndarray) -> Point:
+    """madd with the doubling case handled exactly (one extra _dbl) — used
+    once per verify to build the G+Q table entry, where Q == G must yield 2G
+    (a legitimate, if weird, public key)."""
+    res, exc = _madd(p, qx, qy, q_inf)
+    d = _dbl(p)
+    return Point(
+        fe_select(exc, d.x, res.x),
+        fe_select(exc, d.y, res.y),
+        fe_select(exc, d.z, res.z),
+    )
 
 
-def _bit_at(scalar: jnp.ndarray, j) -> jnp.ndarray:
-    """Bit j (0 = LSB) of a [16]-limb scalar, traced index."""
-    word = lax.dynamic_index_in_dim(scalar, j >> 4, keepdims=False)
-    return (word >> (j & 15).astype(jnp.uint32)) & jnp.uint32(1)
+def _bits_of(scalar_arr: jnp.ndarray) -> jnp.ndarray:
+    """[16] u32 limb array -> [256] bit array, bit j = bit j of the scalar."""
+    shifts = jnp.arange(limbs.LIMB_BITS, dtype=jnp.uint32)
+    return ((scalar_arr[:, None] >> shifts[None, :]) & 1).reshape(256)
 
 
-def _shamir(u1: jnp.ndarray, u2: jnp.ndarray, qx_m: jnp.ndarray, qy_m: jnp.ndarray) -> Point:
+def _shamir(
+    u1_arr: jnp.ndarray, u2_arr: jnp.ndarray, qx_m: Fe, qy_m: Fe
+) -> Tuple[Point, jnp.ndarray]:
     """Interleaved double-scalar multiplication u1*G + u2*Q.
 
-    256 iterations of double-then-select-add against the 3-entry affine
-    table {G, Q, G+Q}; the G+Q entry is built on device with one Fermat
-    inversion.  Everything is one ``fori_loop``: the compiled program is a
-    handful of loop nodes regardless of batch size.
+    256 iterations of double-then-select-add against the affine table
+    {-, Q, G, G+Q} (indexed by 2*bit(u1) + bit(u2)); the G+Q entry is built
+    on device with one Fermat inversion.  One ``fori_loop``: the compiled
+    program is a handful of loop nodes regardless of batch size.
+
+    Returns (result, exc) — exc set if any ladder add hit the incomplete
+    case (lane must be rejected; see module docstring).
     """
     f = FIELD
     one = mont_one(f)
-    gx = jnp.asarray(_GX_M)
-    gy = jnp.asarray(_GY_M)
+    gx: Fe = _GX_M
+    gy: Fe = _GY_M
 
-    # Table entry G+Q (affine). Exceptional Q == ±G handled by _madd/_to_affine.
-    gq = _madd((gx, gy, one), qx_m, qy_m, jnp.bool_(False))
-    gq_xm, gq_ym, gq_z = gq
-    gq_inf = limbs.is_zero(gq_z)
-    zsafe = jnp.where(gq_inf, one, gq_z)
+    # Table entry G+Q (affine).  Q == ±G handled exactly.
+    gq = _madd_complete_table(Point(gx, gy, one), qx_m, qy_m, jnp.bool_(False))
+    gq_inf = fe_is_zero(gq.z)
+    zsafe = fe_select(gq_inf, one, gq.z)
     zi = mont_inv(f, zsafe)
     zi2 = mont_sqr(f, zi)
-    gqx = mont_mul(f, gq_xm, zi2)
-    gqy = mont_mul(f, gq_ym, mont_mul(f, zi, zi2))
+    gqx = mont_mul(f, gq.x, zi2)
+    gqy = mont_mul(f, gq.y, mont_mul(f, zi, zi2))
 
-    # Affine table stacked on a leading index axis, indexed by
-    # d = 2*bit(u1) + bit(u2): [none, Q, G, G+Q].
-    zeros = jnp.zeros_like(one)
-    tab_x = jnp.stack([zeros, qx_m, gx, gqx])
-    tab_y = jnp.stack([zeros, qy_m, gy, gqy])
-    tab_inf = jnp.stack(
-        [jnp.bool_(True), jnp.bool_(False), jnp.bool_(False), gq_inf]
-    )
+    bits1 = _bits_of(u1_arr)
+    bits2 = _bits_of(u2_arr)
 
-    def body(i, acc):
-        j = (255 - i).astype(jnp.int32)
+    def body(i, carry):
+        acc, exc = carry
+        j = 255 - i
         acc = _dbl(acc)
-        d = (_bit_at(u1, j) * 2 + _bit_at(u2, j)).astype(jnp.int32)
-        ax = lax.dynamic_index_in_dim(tab_x, d, keepdims=False)
-        ay = lax.dynamic_index_in_dim(tab_y, d, keepdims=False)
-        ainf = lax.dynamic_index_in_dim(tab_inf, d, keepdims=False)
-        return _madd(acc, ax, ay, ainf)
+        b1 = lax.dynamic_index_in_dim(bits1, j, keepdims=False)
+        b2 = lax.dynamic_index_in_dim(bits2, j, keepdims=False)
+        d = b1 * 2 + b2
+        # Select the table entry with elementwise masks (no gathers).
+        is1, is2, is3 = d == 1, d == 2, d == 3
+        ax = fe_select(is1, qx_m, fe_select(is2, gx, gqx))
+        ay = fe_select(is1, qy_m, fe_select(is2, gy, gqy))
+        ainf = jnp.where(d == 0, jnp.bool_(True), is3 & gq_inf)
+        res, e = _madd(acc, ax, ay, ainf)
+        return res, exc | e
 
-    start: Point = (one, one, jnp.zeros_like(one))  # identity
-    return lax.fori_loop(0, 256, body, start)
+    start = Point(one, one, limbs.fe_zero())  # identity
+    return lax.fori_loop(0, 256, body, (start, jnp.bool_(False)))
 
 
 def _verify_one(
@@ -216,35 +217,34 @@ def _verify_one(
     u1: jnp.ndarray,
     u2: jnp.ndarray,
     r: jnp.ndarray,
+    r2: jnp.ndarray,
+    r2_ok: jnp.ndarray,
     valid: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Scalar-shaped ECDSA verify core; all limb args [16] u32, normal domain.
+    """Scalar-shaped ECDSA verify core; limb-array args [16] u32.
+
+    Checks x(R) ≡ r (mod n) without an affine conversion: with R = (X:Y:Z)
+    Jacobian, x(R) = X/Z^2, so x(R) == c  <=>  X == c*Z^2 (all Montgomery).
+    Host supplies both candidates c ∈ {r, r+n} (the second only when
+    r+n < p, flagged by ``r2_ok``).
 
     ``valid`` carries host-side range checks (r, s in [1, n-1]); the kernel
     AND-folds it so invalid inputs burn the same cycles as valid ones
     (constant shape) but always return False.
     """
     f = FIELD
-    qx_m = to_mont(f, qx)
-    qy_m = to_mont(f, qy)
-    rx, _, inf = _to_affine(_shamir(u1, u2, qx_m, qy_m))
-    # x(R) mod n == r, given x(R) < p < 2n: true iff rx == r or rx - n == r.
-    n_limbs = jnp.asarray(ORDER.modulus)
-    rx_red = jnp.where(
-        limbs._geq(rx, n_limbs), limbs._sub_limbs(rx, n_limbs), rx
-    )
-    ok = limbs_eq(rx_red, r) | limbs_eq(rx, r)
-    return ok & ~inf & valid
+    qx_m = to_mont(f, fe_from_array(qx))
+    qy_m = to_mont(f, fe_from_array(qy))
+    res, exc = _shamir(u1, u2, qx_m, qy_m)
+    inf = fe_is_zero(res.z)
+    z2 = mont_sqr(f, res.z)
+    c1 = mont_mul(f, to_mont(f, fe_from_array(r)), z2)
+    c2 = mont_mul(f, to_mont(f, fe_from_array(r2)), z2)
+    ok = fe_eq(res.x, c1) | (r2_ok & fe_eq(res.x, c2))
+    return ok & ~inf & ~exc & valid
 
 
 _verify_batch = jax.jit(jax.vmap(_verify_one))
-
-
-@functools.lru_cache(maxsize=None)
-def _jitted_for_bucket(_: int):
-    # One cached jitted callable per bucket size (jit caches by shape anyway;
-    # the lru_cache just makes the bucketing explicit and introspectable).
-    return _verify_batch
 
 
 # ---------------------------------------------------------------------------
@@ -266,6 +266,8 @@ def prepare_batch(
     u1 = np.zeros((b, limbs.NLIMBS), np.uint32)
     u2 = np.zeros((b, limbs.NLIMBS), np.uint32)
     rr = np.zeros((b, limbs.NLIMBS), np.uint32)
+    r2 = np.zeros((b, limbs.NLIMBS), np.uint32)
+    r2_ok = np.zeros((b,), np.bool_)
     valid = np.zeros((b,), np.bool_)
     for i, ((x, y), digest, (r, s)) in enumerate(items):
         if not (0 < r < N and 0 < s < N and 0 <= x < P and 0 <= y < P):
@@ -277,8 +279,11 @@ def prepare_batch(
         u1[i] = to_limbs((z * w) % N)
         u2[i] = to_limbs((r * w) % N)
         rr[i] = to_limbs(r)
+        if r + N < P:
+            r2[i] = to_limbs(r + N)
+            r2_ok[i] = True
         valid[i] = True
-    return qx, qy, u1, u2, rr, valid
+    return qx, qy, u1, u2, rr, r2, r2_ok, valid
 
 
 def verify_batch(

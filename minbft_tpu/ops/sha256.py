@@ -66,30 +66,60 @@ def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     """One SHA-256 compression: ``state`` [8] uint32, ``block`` [16] uint32
     (big-endian words) → new state [8] uint32.
 
-    Scalar-shaped; batch via ``jax.vmap``.
+    Scalar-shaped; batch via ``jax.vmap``.  Two lowerings of the same round
+    function (see :mod:`minbft_tpu.ops.lowering`): fully unrolled 64 rounds
+    for TPU fusion, a ``fori_loop`` with a rolling schedule window for the
+    CPU SIM-mode backend.
     """
+    from .lowering import use_unrolled
+
+    if use_unrolled():
+        return _compress_unrolled(state, block)
+    return _compress_loop(state, block)
+
+
+def _round(av, wt, kt):
+    a, b, c, d, e, f, g, h = av
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + kt + wt
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    t2 = s0 + maj
+    return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+
+def _compress_unrolled(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    block = block.astype(jnp.uint32)
+    w = [block[i] for i in range(16)]
+    for t in range(16, 64):
+        sig0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        sig1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append(w[t - 16] + sig0 + w[t - 7] + sig1)
+    av = tuple(state[i] for i in range(8))
+    for t in range(64):
+        av = _round(av, w[t], np.uint32(_K[t]))
+    return state + jnp.stack(av)
+
+
+def _compress_loop(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     k = jnp.asarray(_K)
 
     def round_body(t, carry):
-        a, b, c, d, e, f, g, h, w = carry
+        av = carry[:8]
+        w = carry[8]
         # w is the rolling 16-word schedule window; w[0] == W[t].
-        wt = w[0]
-        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + k[t] + wt
-        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = s0 + maj
+        av = _round(av, w[0], k[t])
         # Extend the schedule: W[t+16] from the current window.
         sig0 = _rotr(w[1], 7) ^ _rotr(w[1], 18) ^ (w[1] >> np.uint32(3))
         sig1 = _rotr(w[14], 17) ^ _rotr(w[14], 19) ^ (w[14] >> np.uint32(10))
         w_next = w[0] + sig0 + w[9] + sig1
         w = jnp.concatenate([w[1:], w_next[None]])
-        return (t1 + t2, a, b, c, d + t1, e, f, g, w)
+        return av + (w,)
 
     init = tuple(state[i] for i in range(8)) + (block.astype(jnp.uint32),)
-    a, b, c, d, e, f, g, h, _ = lax.fori_loop(0, 64, round_body, init)
-    return state + jnp.stack([a, b, c, d, e, f, g, h])
+    out = lax.fori_loop(0, 64, round_body, init)
+    return state + jnp.stack(out[:8])
 
 
 def sha256_fixed(blocks: jnp.ndarray) -> jnp.ndarray:

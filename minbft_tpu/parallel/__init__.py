@@ -1,0 +1,14 @@
+"""Parallel execution layer: the asyncio↔TPU batching engine and device-mesh
+sharding helpers.
+
+This is where the reference's serial per-message CPU crypto (reference
+core/message-handling.go:363-377 validate-then-process, core/commit.go:108-143
+mutex-serialized quorum collection) becomes submit-batch-then-resolve: many
+concurrent protocol tasks await individual verification results while the
+engine coalesces them into fixed-shape batches dispatched to one XLA kernel
+(one chip) or a sharded mesh (many chips).
+"""
+
+from .engine import BatchVerifier, VerifyStats
+
+__all__ = ["BatchVerifier", "VerifyStats"]

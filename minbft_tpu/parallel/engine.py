@@ -1,0 +1,209 @@
+"""Asyncio batching engine for TPU crypto verification.
+
+The reference verifies every signature/UI serially and synchronously in the
+message-handling goroutine (reference sample/authentication/crypto.go:79-89
+called from core/message-handling.go:409-452 and core/usig-ui.go:62-73).
+Here, each protocol task awaits ``BatchVerifier.verify_*`` and the engine:
+
+1. appends the item to the scheme's pending queue,
+2. flushes the queue when it reaches ``max_batch`` items **or** when the
+   oldest item has waited ``max_delay`` seconds (adaptive flush — a single
+   low-load request never stalls waiting for a batch to fill; this is the
+   latency mitigation from SURVEY.md §7 "hard parts"),
+3. pads the batch to a fixed bucket size (one compiled kernel per bucket,
+   never a recompile from a data-dependent shape),
+4. dispatches the jitted kernel on a worker thread (keeping the event loop
+   free) and resolves every awaiting future with its lane's verdict.
+
+Quorum waits (reference core/commit.go:108-143's mutex-serialized collector)
+thereby become "await one batched verify result" — the BASELINE.json north
+star restructuring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _bucket_for(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclasses.dataclass
+class VerifyStats:
+    """Engine counters (the observability the reference lacks, SURVEY.md §5)."""
+
+    items: int = 0
+    batches: int = 0
+    max_batch_seen: int = 0
+    padded_lanes: int = 0
+    device_time_s: float = 0.0
+
+    @property
+    def mean_batch(self) -> float:
+        return self.items / self.batches if self.batches else 0.0
+
+
+class _SchemeQueue:
+    """Pending verifications for one scheme, with adaptive flush."""
+
+    def __init__(self, engine: "BatchVerifier", name: str, dispatch):
+        self.engine = engine
+        self.name = name
+        self.dispatch = dispatch  # List[item] -> np.ndarray[bool]
+        self.pending: List[Tuple[object, asyncio.Future]] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self.stats = VerifyStats()
+
+    def submit(self, item) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self.pending.append((item, fut))
+        if len(self.pending) >= self.engine.max_batch:
+            self._flush_now()
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(
+                self.engine.max_delay, self._flush_now
+            )
+        return fut
+
+    def _flush_now(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        if not self.pending:
+            return
+        batch, self.pending = self.pending, []
+        asyncio.get_running_loop().create_task(self._run(batch))
+
+    async def _run(self, batch) -> None:
+        items = [it for it, _ in batch]
+        t0 = time.monotonic()
+        try:
+            results = await asyncio.to_thread(self.dispatch, items)
+        except Exception as e:  # resolve all futures with the failure
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        dt = time.monotonic() - t0
+        st = self.stats
+        st.items += len(batch)
+        st.batches += 1
+        st.max_batch_seen = max(st.max_batch_seen, len(batch))
+        st.device_time_s += dt
+        for (_, fut), ok in zip(batch, results):
+            if not fut.done():
+                fut.set_result(bool(ok))
+
+
+class BatchVerifier:
+    """The TPU-backed batch verification engine.
+
+    Schemes: ``ecdsa_p256`` (items: ((qx, qy), digest32, (r, s))),
+    ``hmac_sha256`` (items: (key32, msg32, mac32) bytes), and
+    ``ed25519`` (items: (pub32, msg, sig64) bytes).
+
+    ``max_batch`` bounds the device batch (and the largest compiled bucket);
+    ``max_delay`` bounds the latency a lone verification can suffer waiting
+    for co-batching.
+    """
+
+    def __init__(
+        self,
+        max_batch: int = 512,
+        max_delay: float = 0.002,
+        buckets: Optional[Sequence[int]] = None,
+    ):
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        # Default: ONE padded shape.  Every distinct bucket size is a
+        # separate (expensive) kernel compilation; padding a short batch to
+        # max_batch costs far less than a recompile, and one shape keeps
+        # warm-up deterministic.  Pass explicit buckets to trade padding
+        # work for more compiled shapes.
+        self.buckets = tuple(buckets) if buckets else (max_batch,)
+        self._queues: Dict[str, _SchemeQueue] = {}
+
+    # -- queues -------------------------------------------------------------
+
+    def _queue(self, name: str, dispatch) -> _SchemeQueue:
+        q = self._queues.get(name)
+        if q is None:
+            q = _SchemeQueue(self, name, dispatch)
+            self._queues[name] = q
+        return q
+
+    @property
+    def stats(self) -> Dict[str, VerifyStats]:
+        return {name: q.stats for name, q in self._queues.items()}
+
+    # -- public API ---------------------------------------------------------
+
+    async def verify_ecdsa_p256(
+        self, pubkey: Tuple[int, int], digest: bytes, sig: Tuple[int, int]
+    ) -> bool:
+        q = self._queue("ecdsa_p256", self._dispatch_ecdsa)
+        return await q.submit((pubkey, digest, sig))
+
+    async def verify_hmac_sha256(self, key: bytes, msg32: bytes, mac: bytes) -> bool:
+        q = self._queue("hmac_sha256", self._dispatch_hmac)
+        return await q.submit((key, msg32, mac))
+
+    async def verify_ed25519(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
+        q = self._queue("ed25519", self._dispatch_ed25519)
+        return await q.submit((pub, msg, sig))
+
+    # -- dispatchers (worker thread; jax work happens here) -----------------
+
+    def _dispatch_ecdsa(self, items) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ..ops import p256
+
+        n = len(items)
+        b = _bucket_for(n, self.buckets)
+        arrays = p256.prepare_batch(list(items) + [_ECDSA_PAD] * (b - n))
+        self._queues["ecdsa_p256"].stats.padded_lanes += b - n
+        out = p256.ecdsa_verify_kernel(*[jnp.asarray(a) for a in arrays])
+        return np.asarray(out)[:n]
+
+    def _dispatch_hmac(self, items) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ..ops.hmac_sha256 import hmac_verify_kernel
+
+        n = len(items)
+        b = _bucket_for(n, self.buckets)
+        keys = np.zeros((b, 8), np.uint32)
+        msgs = np.zeros((b, 8), np.uint32)
+        macs = np.zeros((b, 8), np.uint32)
+        for i, (key, msg, mac) in enumerate(items):
+            keys[i] = np.frombuffer(key, dtype=">u4").astype(np.uint32)
+            msgs[i] = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
+            macs[i] = np.frombuffer(mac, dtype=">u4").astype(np.uint32)
+        self._queues["hmac_sha256"].stats.padded_lanes += b - n
+        out = hmac_verify_kernel(
+            jnp.asarray(keys), jnp.asarray(msgs), jnp.asarray(macs)
+        )
+        return np.asarray(out)[:n]
+
+    def _dispatch_ed25519(self, items) -> np.ndarray:
+        from ..ops import ed25519 as ed
+
+        n = len(items)
+        b = _bucket_for(n, self.buckets)
+        self._queues["ed25519"].stats.padded_lanes += b - n
+        return ed.verify_batch_padded(list(items), b)[:n]
+
+
+# A structurally valid-but-failing pad item (valid=False lane).
+_ECDSA_PAD = ((0, 0), b"\x00" * 32, (0, 0))

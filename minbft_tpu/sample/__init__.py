@@ -1,0 +1,4 @@
+"""Sample stack: pluggable external modules for the core protocol
+(reference sample/): authentication schemes + keystore, connectors
+(in-process and TCP), configuration, the SimpleLedger request consumer, and
+the peer CLI."""

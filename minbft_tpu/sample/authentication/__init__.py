@@ -1,0 +1,13 @@
+"""Authentication: schemes, authenticator, keystore
+(reference sample/authentication/).
+
+The reference's ``Authenticator`` maps roles to authentication schemes
+backed by a YAML keystore (reference authenticator.go:88-116).  The TPU
+build adds the north-star piece: :class:`SampleAuthenticator` dispatches
+*verification* through the :class:`minbft_tpu.parallel.BatchVerifier`, so
+every concurrent protocol validation joins a batched XLA kernel launch
+("TPUAuthenticator" in BASELINE.json)."""
+
+from .authenticator import SampleAuthenticator, new_test_authenticators
+
+__all__ = ["SampleAuthenticator", "new_test_authenticators"]

@@ -1,0 +1,267 @@
+"""The sample Authenticator: role → scheme dispatch with TPU batch verify.
+
+Reference sample/authentication/authenticator.go:43-116 maps each role to an
+``AuthenticationScheme`` from the keystore keyspec (ECDSA → public-key
+scheme, SGX_ECDSA → USIG scheme).  This build's authenticator additionally
+takes a :class:`minbft_tpu.parallel.BatchVerifier`: every ``verify`` call
+becomes an awaitable batch lane — **this is the TPUAuthenticator of
+BASELINE.json** ("accumulates PREPARE/COMMIT/REQUEST signature checks into
+fixed-size batches and dispatches them to a jax.vmap'd verifier").
+
+Scheme wire formats (canonical, defined by this build):
+
+- ECDSA-P256 signature tag: r(32) || s(32), big-endian.
+- Ed25519 signature tag: RFC 8032 (R(32) || S(32)).
+- USIG tag: marshalled UI = counter_be8 || cert, where cert =
+  epoch(8) || scheme-specific certificate (see minbft_tpu/usig/software.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Optional, Tuple
+
+from ... import api
+from ...messages import UI
+from ...parallel import BatchVerifier
+from ...usig.software import EcdsaUSIG, HmacUSIG, _signed_payload, parse_usig_id
+from ...utils import hostcrypto as hc
+
+_EPOCH_LEN = 8
+
+
+class SigScheme:
+    """Public-key signature scheme plug-in (reference SignatureCipher +
+    PublicAuthenScheme, sample/authentication/crypto.go:36-126)."""
+
+    name = "?"
+
+    def sign(self, priv, msg: bytes) -> bytes:
+        raise NotImplementedError
+
+    async def verify(self, pub, msg: bytes, tag: bytes, engine) -> bool:
+        raise NotImplementedError
+
+
+class EcdsaScheme(SigScheme):
+    name = "ecdsa-p256"
+
+    def sign(self, priv: int, msg: bytes) -> bytes:
+        digest = hashlib.sha256(msg).digest()
+        r, s = hc.ecdsa_sign(priv, digest)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    async def verify(
+        self, pub: Tuple[int, int], msg: bytes, tag: bytes, engine
+    ) -> bool:
+        if len(tag) != 64:
+            return False
+        digest = hashlib.sha256(msg).digest()
+        sig = (int.from_bytes(tag[:32], "big"), int.from_bytes(tag[32:], "big"))
+        if engine is not None:
+            return await engine.verify_ecdsa_p256(pub, digest, sig)
+        return hc.ecdsa_verify(pub, digest, sig)
+
+
+class Ed25519Scheme(SigScheme):
+    name = "ed25519"
+
+    def sign(self, priv: bytes, msg: bytes) -> bytes:
+        return hc.ed25519_sign(priv, hashlib.sha256(msg).digest())
+
+    async def verify(self, pub: bytes, msg: bytes, tag: bytes, engine) -> bool:
+        digest = hashlib.sha256(msg).digest()
+        if engine is not None:
+            return await engine.verify_ed25519(pub, digest, tag)
+        return hc.ed25519_verify(pub, digest, tag)
+
+
+SCHEMES = {s.name: s for s in (EcdsaScheme(), Ed25519Scheme())}
+
+
+class SampleAuthenticator(api.Authenticator):
+    """Role-dispatching authenticator with TPU-batched verification.
+
+    ``sig_keys``: {role: (own_private_key, {peer_id: public_key})} for the
+    CLIENT/REPLICA roles (only the roles this node plays need a private
+    key; pass None).  ``usig``: own USIG instance (replicas only).
+    ``usig_ids``: {replica_id: usig_id bytes} — trust anchors for peers'
+    USIGs (the reference captures epochs trust-on-first-use,
+    crypto.go:204-218; here IDs are distributed via the keystore, which is
+    the stronger and simpler assumption).
+    """
+
+    def __init__(
+        self,
+        scheme: str = "ecdsa-p256",
+        client_priv=None,
+        client_pubs: Optional[Dict[int, object]] = None,
+        replica_priv=None,
+        replica_pubs: Optional[Dict[int, object]] = None,
+        usig=None,
+        usig_ids: Optional[Dict[int, bytes]] = None,
+        engine: Optional[BatchVerifier] = None,
+        batch_signatures: bool = True,
+    ):
+        self._scheme = SCHEMES[scheme]
+        self._client_priv = client_priv
+        self._client_pubs = client_pubs or {}
+        self._replica_priv = replica_priv
+        self._replica_pubs = replica_pubs or {}
+        self._usig = usig
+        self._usig_ids = usig_ids or {}
+        self._engine = engine
+        # Batch the public-key signature checks too (on by default; tests
+        # may disable it to exercise only the USIG batch path without
+        # paying the big-kernel compile on the CPU SIM backend).
+        self._batch_signatures = batch_signatures
+
+    # -- generation ---------------------------------------------------------
+
+    def generate_message_authen_tag(
+        self, role: api.AuthenticationRole, msg: bytes
+    ) -> bytes:
+        if role == api.AuthenticationRole.CLIENT:
+            if self._client_priv is None:
+                raise api.AuthenticationError("no client key")
+            return self._scheme.sign(self._client_priv, msg)
+        if role == api.AuthenticationRole.REPLICA:
+            if self._replica_priv is None:
+                raise api.AuthenticationError("no replica key")
+            return self._scheme.sign(self._replica_priv, msg)
+        if role == api.AuthenticationRole.USIG:
+            if self._usig is None:
+                raise api.AuthenticationError("no USIG")
+            return self._usig.create_ui(msg).to_bytes()
+        raise api.AuthenticationError(f"unknown role {role}")
+
+    # -- verification -------------------------------------------------------
+
+    async def verify_message_authen_tag(
+        self, role: api.AuthenticationRole, peer_id: int, msg: bytes, tag: bytes
+    ) -> None:
+        sig_engine = self._engine if self._batch_signatures else None
+        if role == api.AuthenticationRole.CLIENT:
+            pub = self._client_pubs.get(peer_id)
+            if pub is None:
+                raise api.AuthenticationError(f"unknown client {peer_id}")
+            if not await self._scheme.verify(pub, msg, tag, sig_engine):
+                raise api.AuthenticationError("bad client signature")
+            return
+        if role == api.AuthenticationRole.REPLICA:
+            pub = self._replica_pubs.get(peer_id)
+            if pub is None:
+                raise api.AuthenticationError(f"unknown replica {peer_id}")
+            if not await self._scheme.verify(pub, msg, tag, sig_engine):
+                raise api.AuthenticationError("bad replica signature")
+            return
+        if role == api.AuthenticationRole.USIG:
+            await self._verify_usig(peer_id, msg, tag)
+            return
+        raise api.AuthenticationError(f"unknown role {role}")
+
+    async def _verify_usig(self, peer_id: int, msg: bytes, tag: bytes) -> None:
+        usig_id = self._usig_ids.get(peer_id)
+        if usig_id is None:
+            raise api.AuthenticationError(f"unknown USIG for replica {peer_id}")
+        try:
+            ui = UI.from_bytes(tag)
+        except ValueError as e:
+            raise api.AuthenticationError(f"malformed UI: {e}") from e
+        if ui.counter == 0:
+            raise api.AuthenticationError("zero UI counter")
+        if self._engine is not None and isinstance(self._usig, EcdsaUSIG):
+            # Batched TPU verification of the UI certificate (the TPU-USIG
+            # of BASELINE.json).
+            from ...usig.software import UsigError, usig_verify_items
+
+            try:
+                q, payload, sig = usig_verify_items(msg, ui, usig_id)
+            except UsigError as e:
+                raise api.AuthenticationError(str(e)) from e
+            if not await self._engine.verify_ecdsa_p256(q, payload, sig):
+                raise api.AuthenticationError("invalid UI certificate")
+            return
+        if self._engine is not None and isinstance(self._usig, HmacUSIG):
+            epoch, _fp = parse_usig_id(usig_id)
+            if len(ui.cert) < _EPOCH_LEN + 32 or ui.cert[:_EPOCH_LEN] != epoch:
+                raise api.AuthenticationError("epoch mismatch")
+            digest = hashlib.sha256(msg).digest()
+            payload = _signed_payload(digest, epoch, ui.counter)
+            mac = ui.cert[_EPOCH_LEN : _EPOCH_LEN + 32]
+            if not await self._engine.verify_hmac_sha256(
+                self._usig._key, payload, mac
+            ):
+                raise api.AuthenticationError("invalid UI certificate")
+            return
+        # Serial host fallback (SIM mode without an engine).
+        if self._usig is None:
+            raise api.AuthenticationError("no USIG to verify with")
+        from ...usig import UsigError
+
+        try:
+            self._usig.verify_ui(msg, ui, usig_id)
+        except UsigError as e:
+            raise api.AuthenticationError(str(e)) from e
+
+
+def new_test_authenticators(
+    n: int,
+    n_clients: int = 1,
+    scheme: str = "ecdsa-p256",
+    usig_kind: str = "ecdsa",
+    engine: Optional[BatchVerifier] = None,
+    engines: Optional[list] = None,
+    batch_signatures: bool = True,
+):
+    """Generate a coherent set of authenticators for an in-process testnet
+    (the reference's GenerateTestnetKeys equivalent,
+    sample/authentication/keymanager.go:404-450).
+
+    Returns (replica_auths, client_auths)."""
+    if scheme == "ecdsa-p256":
+        replica_keys = [hc.keygen() for _ in range(n)]
+        client_keys = [hc.keygen() for _ in range(n_clients)]
+        replica_pubs = {i: q for i, (_, q) in enumerate(replica_keys)}
+        client_pubs = {i: q for i, (_, q) in enumerate(client_keys)}
+    elif scheme == "ed25519":
+        replica_keys = [hc.ed25519_keygen() for _ in range(n)]
+        client_keys = [hc.ed25519_keygen() for _ in range(n_clients)]
+        replica_pubs = {i: pub for i, (_, pub) in enumerate(replica_keys)}
+        client_pubs = {i: pub for i, (_, pub) in enumerate(client_keys)}
+    else:
+        raise ValueError(scheme)
+
+    if usig_kind == "ecdsa":
+        usigs = [EcdsaUSIG() for _ in range(n)]
+    elif usig_kind == "hmac":
+        shared = hashlib.sha256(b"testnet-usig-key").digest()
+        usigs = [HmacUSIG(shared) for _ in range(n)]
+    else:
+        raise ValueError(usig_kind)
+    usig_ids = {i: u.id() for i, u in enumerate(usigs)}
+
+    replica_auths = [
+        SampleAuthenticator(
+            scheme=scheme,
+            replica_priv=replica_keys[i][0],
+            replica_pubs=replica_pubs,
+            client_pubs=client_pubs,
+            usig=usigs[i],
+            usig_ids=usig_ids,
+            engine=(engines[i] if engines else engine),
+            batch_signatures=batch_signatures,
+        )
+        for i in range(n)
+    ]
+    client_auths = [
+        SampleAuthenticator(
+            scheme=scheme,
+            client_priv=client_keys[i][0],
+            replica_pubs=replica_pubs,
+            client_pubs=client_pubs,
+            engine=None,  # clients verify replies serially (cheap, f+1 small)
+        )
+        for i in range(n_clients)
+    ]
+    return replica_auths, client_auths

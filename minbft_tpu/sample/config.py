@@ -1,0 +1,104 @@
+"""Configuration provider (reference sample/config/viperconfiger.go).
+
+``SimpleConfiger`` is the programmatic form; ``load_config`` reads the YAML
+schema of the reference's consensus.yaml (protocol.{n,f,checkpointPeriod,
+logsize,timeout.{request,prepare,viewchange}}, peers[] with id/addr) via
+PyYAML (baked into the runtime image).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from .. import api
+
+
+@dataclasses.dataclass
+class PeerAddr:
+    id: int
+    addr: str
+
+
+class SimpleConfiger(api.Configer):
+    def __init__(
+        self,
+        n: int,
+        f: int,
+        checkpoint_period: int = 0,
+        logsize: int = 0,
+        timeout_request: float = 2.0,
+        timeout_prepare: float = 1.0,
+        peers: Optional[List[PeerAddr]] = None,
+    ):
+        self._n = n
+        self._f = f
+        self._checkpoint_period = checkpoint_period
+        self._logsize = logsize
+        self._timeout_request = timeout_request
+        self._timeout_prepare = timeout_prepare
+        self.peers = peers or []
+
+    @property
+    def n(self) -> int:
+        return self._n
+
+    @property
+    def f(self) -> int:
+        return self._f
+
+    @property
+    def checkpoint_period(self) -> int:
+        return self._checkpoint_period
+
+    @property
+    def logsize(self) -> int:
+        return self._logsize
+
+    @property
+    def timeout_request(self) -> float:
+        return self._timeout_request
+
+    @property
+    def timeout_prepare(self) -> float:
+        return self._timeout_prepare
+
+
+def load_config(path: str) -> SimpleConfiger:
+    """Load a consensus.yaml (reference sample/config/consensus.yaml schema)."""
+    with open(path) as fh:
+        text = fh.read()
+    data = _parse_yaml(text)
+    proto = data.get("protocol", {})
+    timeout = proto.get("timeout", {})
+    peers = [
+        PeerAddr(id=int(p["id"]), addr=str(p["addr"]))
+        for p in data.get("peers", [])
+    ]
+    return SimpleConfiger(
+        n=int(proto["n"]),
+        f=int(proto["f"]),
+        checkpoint_period=int(proto.get("checkpointPeriod", 0)),
+        logsize=int(proto.get("logsize", 0)),
+        timeout_request=_seconds(timeout.get("request", "2s")),
+        timeout_prepare=_seconds(timeout.get("prepare", "1s")),
+        peers=peers,
+    )
+
+
+def _seconds(v) -> float:
+    """'1500ms' / '2s' / numeric → seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000.0
+    if s.endswith("s"):
+        return float(s[:-1])
+    return float(s)
+
+
+def _parse_yaml(text: str) -> Dict:
+    import yaml  # baked into the environment
+
+    return yaml.safe_load(text) or {}

@@ -1,0 +1,85 @@
+"""In-process connectivity: replica stubs and the dummy connector.
+
+Reference sample/conn/common/replicastub (late-binding ConnectionHandler
+that buffers stream requests until the replica is assigned — this is what
+lets an in-process test network wire circular topologies) and
+sample/conn/dummy/connector (same-process connector over the stubs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Dict, Optional
+
+from ... import api
+
+
+class ReplicaStub(api.ConnectionHandler):
+    """Late-binding connection handler (reference
+    sample/conn/common/replicastub/replica-stub.go:26-105)."""
+
+    def __init__(self):
+        self._replica: Optional[api.Replica] = None
+        self._ready = asyncio.Event()
+
+    def assign_replica(self, replica: api.Replica) -> None:
+        self._replica = replica
+        self._ready.set()
+
+    def peer_message_stream_handler(self) -> api.MessageStreamHandler:
+        return _DeferredHandler(self, "peer")
+
+    def client_message_stream_handler(self) -> api.MessageStreamHandler:
+        return _DeferredHandler(self, "client")
+
+
+class _DeferredHandler(api.MessageStreamHandler):
+    def __init__(self, stub: ReplicaStub, kind: str):
+        self._stub = stub
+        self._kind = kind
+
+    async def handle_message_stream(
+        self, in_stream: AsyncIterator[bytes]
+    ) -> AsyncIterator[bytes]:
+        await self._stub._ready.wait()
+        replica = self._stub._replica
+        handler = (
+            replica.peer_message_stream_handler()
+            if self._kind == "peer"
+            else replica.client_message_stream_handler()
+        )
+        async for out in handler.handle_message_stream(in_stream):
+            yield out
+
+
+class InProcessPeerConnector(api.ReplicaConnector):
+    """Replica-side connector (reference sample/conn/common/connector.go:62-78
+    resolving PeerMessageStreamHandler)."""
+
+    def __init__(self, stubs: Dict[int, ReplicaStub]):
+        self._stubs = stubs
+
+    def replica_message_stream_handler(
+        self, replica_id: int
+    ) -> Optional[api.MessageStreamHandler]:
+        stub = self._stubs.get(replica_id)
+        return stub.peer_message_stream_handler() if stub else None
+
+
+class InProcessClientConnector(api.ReplicaConnector):
+    """Client-side connector resolving ClientMessageStreamHandler."""
+
+    def __init__(self, stubs: Dict[int, ReplicaStub]):
+        self._stubs = stubs
+
+    def replica_message_stream_handler(
+        self, replica_id: int
+    ) -> Optional[api.MessageStreamHandler]:
+        stub = self._stubs.get(replica_id)
+        return stub.client_message_stream_handler() if stub else None
+
+
+def make_testnet_stubs(n: int) -> Dict[int, ReplicaStub]:
+    """Stub per replica, for wiring a circular in-process topology
+    (reference core/integration_test.go:166-197)."""
+    return {i: ReplicaStub() for i in range(n)}

@@ -1,0 +1,55 @@
+"""SimpleLedger: a hash-chained block ledger as the sample state machine.
+
+Reference sample/requestconsumer/simpleledger.go: one block per delivered
+request, each block carrying the previous block's hash; ``state_digest`` is
+the hash of the last block.  The reference runs a serial executor goroutine
+over a queue (113-134); here delivery happens on the event loop, which is
+already serial — the protocol's commitment collector releases executions in
+order (minbft_tpu/core/commit.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import List, Optional
+
+from ... import api
+
+
+class Block:
+    def __init__(self, height: int, prev_hash: bytes, payload: bytes):
+        self.height = height
+        self.prev_hash = prev_hash
+        self.payload = payload
+
+    def digest(self) -> bytes:
+        return hashlib.sha256(
+            struct.pack(">Q", self.height) + self.prev_hash + self.payload
+        ).digest()
+
+
+class SimpleLedger(api.RequestConsumer):
+    def __init__(self):
+        genesis = Block(0, b"\x00" * 32, b"genesis")
+        self._blocks: List[Block] = [genesis]
+
+    async def deliver(self, operation: bytes) -> bytes:
+        """Append one block per operation (reference simpleledger.go:168-187);
+        the result returned to the client is the new block's digest."""
+        prev = self._blocks[-1]
+        block = Block(prev.height + 1, prev.digest(), operation)
+        self._blocks.append(block)
+        return block.digest()
+
+    def state_digest(self) -> bytes:
+        return self._blocks[-1].digest()
+
+    @property
+    def length(self) -> int:
+        """Number of blocks excluding genesis (reference ledger length
+        assertions in core/integration_test.go:199-210)."""
+        return len(self._blocks) - 1
+
+    def block(self, height: int) -> Optional[Block]:
+        return self._blocks[height] if 0 <= height < len(self._blocks) else None

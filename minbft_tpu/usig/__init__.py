@@ -1,0 +1,23 @@
+"""USIG — Unique Sequential Identifier Generator (the trusted component).
+
+Mirrors the reference ``usig`` package (reference usig/usig.go:28-51) and the
+SGX enclave semantics (reference usig/sgx/enclave/usig.c:36-76): a per-
+replica monotonic counter bound to message digests under a per-instance
+epoch, such that a (digest, counter) pair can never be produced twice —
+the property that lets MinBFT run with n = 2f+1 replicas and 2 rounds.
+
+Implementations:
+
+- :class:`minbft_tpu.usig.software.HmacUSIG` — SGX-less symmetric mode
+  (BASELINE config[0]); cluster-shared MAC key stands in for hardware trust.
+- :class:`minbft_tpu.usig.software.EcdsaUSIG` — the reference enclave's
+  scheme (ECDSA-P256 over {digest, epoch, counter}); public verification,
+  batchable on TPU via :mod:`minbft_tpu.ops.p256`.
+- ``minbft_tpu.native`` — C++ implementation of the same semantics with
+  key sealing (the reference's enclave/shim equivalent), preferred when
+  built.
+"""
+
+from .usig import UI, USIG, UsigError, ui_from_bytes, ui_to_bytes
+
+__all__ = ["UI", "USIG", "UsigError", "ui_from_bytes", "ui_to_bytes"]

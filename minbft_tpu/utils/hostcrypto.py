@@ -149,7 +149,6 @@ ED_P = 2**255 - 19
 ED_L = 2**252 + 27742317777372353535851937790883648493
 ED_D = (-121665 * pow(121666, -1, ED_P)) % ED_P
 ED_BY = (4 * pow(5, -1, ED_P)) % ED_P
-_bx_num = pow((ED_BY * ED_BY - 1) % ED_P, 1, ED_P)
 
 
 def _ed_recover_x(y: int, sign: int):

@@ -1,0 +1,116 @@
+"""Commitment collector unit tests (reference core/commit_test.go:112-320):
+quorum counting, sequential-CV enforcement, replay handling, and in-order
+execution under reordered/concurrent quorum completion (the race batched
+validation makes possible)."""
+
+import asyncio
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.core.commit import make_commitment_collector
+from minbft_tpu.messages import UI, Prepare, Request
+
+
+def _prepare(cv: int, view: int = 0, primary: int = 0) -> Prepare:
+    req = Request(client_id=0, seq=cv, operation=b"op-%d" % cv)
+    return Prepare(replica_id=primary, view=view, request=req, ui=UI(counter=cv))
+
+
+def test_quorum_at_f_plus_1():
+    async def run():
+        executed = []
+
+        async def execute(request):
+            executed.append(request.seq)
+
+        collect = make_commitment_collector(1, execute)  # f=1 -> quorum 2
+        p = _prepare(1)
+        await collect(0, p)  # primary's own PREPARE
+        assert executed == []
+        await collect(1, p)  # one backup commit -> quorum
+        assert executed == [1]
+        await collect(2, p)  # extra commit: no re-execution
+        assert executed == [1]
+
+    asyncio.run(run())
+
+
+def test_non_sequential_cv_rejected():
+    async def run():
+        collect = make_commitment_collector(1, lambda r: None)
+        await collect(0, _prepare(1))
+        with pytest.raises(api.AuthenticationError):
+            await collect(0, _prepare(3))  # skips CV 2
+
+    asyncio.run(run())
+
+
+def test_replayed_commitment_ignored():
+    async def run():
+        executed = []
+
+        async def execute(request):
+            executed.append(request.seq)
+
+        collect = make_commitment_collector(1, execute)
+        p = _prepare(1)
+        await collect(0, p)
+        await collect(0, p)  # replay from same replica: no double count
+        assert executed == []
+        await collect(1, p)
+        assert executed == [1]
+
+    asyncio.run(run())
+
+
+def test_execution_stays_in_cv_order_with_slow_consumer():
+    """A suspended execution (consumer that actually awaits) must not be
+    overtaken by a later CV whose quorum completes meanwhile."""
+
+    async def run():
+        executed = []
+        gate = asyncio.Event()
+
+        async def execute(request):
+            if request.seq == 1:
+                await gate.wait()  # CV 1 execution suspends mid-deliver
+            executed.append(request.seq)
+
+        collect = make_commitment_collector(1, execute)
+        p1, p2 = _prepare(1), _prepare(2)
+        await collect(0, p1)
+        await collect(0, p2)
+        # Complete CV1's quorum in a background task; it blocks on the gate.
+        t1 = asyncio.create_task(collect(1, p1))
+        await asyncio.sleep(0.01)
+        # CV2's quorum completes while CV1 is still executing.
+        t2 = asyncio.create_task(collect(1, p2))
+        await asyncio.sleep(0.01)
+        assert executed == []  # CV2 must not run ahead of CV1
+        gate.set()
+        await asyncio.gather(t1, t2)
+        assert executed == [1, 2]
+
+    asyncio.run(run())
+
+
+def test_out_of_order_quorum_completion_releases_in_order():
+    async def run():
+        executed = []
+
+        async def execute(request):
+            executed.append(request.seq)
+
+        collect = make_commitment_collector(1, execute)
+        p1, p2 = _prepare(1), _prepare(2)
+        # Replica 0 (primary) commits both in order.
+        await collect(0, p1)
+        await collect(0, p2)
+        # Replica 1's commitments arrive; CV2's quorum completes *after*
+        # CV1's, but execution is released 1 then 2 regardless.
+        await collect(1, p1)
+        await collect(1, p2)
+        assert executed == [1, 2]
+
+    asyncio.run(run())

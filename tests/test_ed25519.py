@@ -1,0 +1,50 @@
+"""Differential tests: batched Ed25519 TPU kernel vs the host RFC 8032
+reference verifier (valid, tampered, wrong-key, malformed, non-canonical)."""
+
+import hashlib
+
+import pytest
+
+from minbft_tpu.ops import ed25519 as ed
+from minbft_tpu.utils import hostcrypto as hc
+
+
+def test_host_reference_roundtrip():
+    seed, pub = hc.ed25519_keygen(b"\x01" * 32)
+    msg = b"hello ed25519"
+    sig = hc.ed25519_sign(seed, msg)
+    assert hc.ed25519_verify(pub, msg, sig)
+    assert not hc.ed25519_verify(pub, msg + b"x", sig)
+
+
+def test_kernel_matches_host():
+    items, expected = [], []
+    for i in range(3):
+        seed, pub = hc.ed25519_keygen(bytes([i]) * 32)
+        msg = hashlib.sha256(b"msg-%d" % i).digest()
+        sig = hc.ed25519_sign(seed, msg)
+        items.append((pub, msg, sig))
+        expected.append(True)
+
+    seed0, pub0 = hc.ed25519_keygen(b"\x09" * 32)
+    msg = hashlib.sha256(b"orig").digest()
+    sig = hc.ed25519_sign(seed0, msg)
+    # tampered message
+    items.append((pub0, hashlib.sha256(b"tampered").digest(), sig))
+    expected.append(False)
+    # wrong key
+    items.append((items[0][0], msg, sig))
+    expected.append(False)
+    # bit-flipped R
+    items.append((pub0, msg, bytes([sig[0] ^ 1]) + sig[1:]))
+    expected.append(False)
+    # S out of range (S + L)
+    s_big = (int.from_bytes(sig[32:], "little") + hc.ED_L).to_bytes(32, "little")
+    items.append((pub0, msg, sig[:32] + s_big))
+    expected.append(False)
+    # truncated signature
+    items.append((pub0, msg, sig[:63]))
+    expected.append(False)
+
+    got = list(ed.verify_batch(items))
+    assert got == expected

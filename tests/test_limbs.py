@@ -71,9 +71,15 @@ def test_fermat_inverse():
 
 
 def test_vmap_batch_matches_scalar():
+    from minbft_tpu.ops.limbs import fe_from_array, fe_to_array
+
     spec = FieldSpec.make(P256_N)
     batched = jax.jit(
-        jax.vmap(lambda a, b: mont_mul(spec, a, b))
+        jax.vmap(
+            lambda a, b: fe_to_array(
+                mont_mul(spec, fe_from_array(a), fe_from_array(b))
+            )
+        )
     )
     import numpy as np
 
@@ -84,3 +90,22 @@ def test_vmap_batch_matches_scalar():
     r_inv = pow(1 << 256, -1, P256_N)
     for i, (x, y) in enumerate(vals):
         assert from_limbs(out[i]) == (x * y * r_inv) % P256_N
+
+
+def test_unrolled_matches_scan_lowering():
+    """The TPU 'unrolled' lowering and the CPU 'scan' lowering are the same
+    arithmetic — pin their equivalence on a handful of values."""
+    from minbft_tpu.ops import limbs as L
+
+    spec = FieldSpec.make(P256_P)
+    a = jnp.asarray(to_limbs(secrets.randbelow(P256_P)))
+    b = jnp.asarray(to_limbs(secrets.randbelow(P256_P)))
+    at, bt = L.fe_from_array(a), L.fe_from_array(b)
+    try:
+        L.set_mode("scan")
+        ref = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
+        L.set_mode("unrolled")
+        got = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
+    finally:
+        L.set_mode(None)
+    assert got == ref

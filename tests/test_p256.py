@@ -22,27 +22,33 @@ def keys():
 
 def test_point_ops_match_host():
     f = p256.FIELD
-    one = jnp.asarray(f.r_mod)
-    gx, gy = jnp.asarray(p256._GX_M), jnp.asarray(p256._GY_M)
+    one = f.r_mod
+    gx, gy = p256._GX_M, p256._GY_M
 
-    def to_affine_host(x, y, z):
+    def to_affine_host(pt):
         from minbft_tpu.ops.limbs import from_mont
 
-        xi, yi, zi = (from_limbs(from_mont(f, v)) for v in (x, y, z))
+        xi, yi, zi = (from_limbs(from_mont(f, v)) for v in pt)
         if zi == 0:
             return None
         z_inv = pow(zi, -1, hc.P)
         return (xi * z_inv**2 % hc.P, yi * z_inv**3 % hc.P)
 
-    d2 = jax.jit(p256._dbl)((gx, gy, one))
-    assert to_affine_host(*d2) == hc.point_double((hc.GX, hc.GY))
+    d2 = jax.jit(p256._dbl)(p256.Point(gx, gy, one))
+    assert to_affine_host(d2) == hc.point_double((hc.GX, hc.GY))
 
     madd = jax.jit(lambda p, qx, qy: p256._madd(p, qx, qy, jnp.bool_(False)))
-    assert to_affine_host(*madd(d2, gx, gy)) == hc.scalar_mult(3, (hc.GX, hc.GY))
-    # exceptional case P == Q routes through the doubling formula
-    assert to_affine_host(*madd((gx, gy, one), gx, gy)) == hc.point_double(
-        (hc.GX, hc.GY)
-    )
+    p3, exc = madd(d2, gx, gy)
+    assert to_affine_host(p3) == hc.scalar_mult(3, (hc.GX, hc.GY))
+    assert not bool(exc)
+    # the incomplete case P == Q is flagged, and the table-building variant
+    # resolves it through the doubling formula
+    _, exc = madd(p256.Point(gx, gy, one), gx, gy)
+    assert bool(exc)
+    tbl = jax.jit(
+        lambda p, qx, qy: p256._madd_complete_table(p, qx, qy, jnp.bool_(False))
+    )(p256.Point(gx, gy, one), gx, gy)
+    assert to_affine_host(tbl) == hc.point_double((hc.GX, hc.GY))
 
 
 def test_verify_batch_valid_and_forged(keys):
