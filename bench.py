@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Headline metric (BASELINE.json): batched ECDSA-P256 signature verifies per
+second on one TPU chip (target >= 50,000), measured device-resident on the
+jitted batch kernel.  Extras report the HMAC kernel rate and an end-to-end
+committed-requests/sec figure from an in-process n=7 f=3 cluster whose
+COMMIT-phase verification runs through the batching engine.
+
+Environment knobs:
+  MINBFT_BENCH_BATCH      ECDSA batch size (default 4096)
+  MINBFT_BENCH_REQUESTS   end-to-end request count (default 200)
+  MINBFT_BENCH_SKIP_E2E   set to skip the cluster phase
+"""
+
+import asyncio
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/minbft_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_VERIFIES_PER_SEC = 50_000.0
+
+
+def bench_ecdsa(batch: int) -> dict:
+    from minbft_tpu.ops import p256
+    from minbft_tpu.utils import hostcrypto as hc
+
+    d, q = hc.keygen()
+    digest = hashlib.sha256(b"bench").digest()
+    sig = hc.ecdsa_sign(d, digest)
+    items = [(q, digest, sig)] * batch
+    arrays = [jax.device_put(jnp.asarray(a)) for a in p256.prepare_batch(items)]
+    t0 = time.time()
+    out = p256.ecdsa_verify_kernel(*arrays)
+    out.block_until_ready()
+    compile_s = time.time() - t0
+    assert bool(np.asarray(out).all()), "self-check failed: valid batch rejected"
+    # negative control: corrupted lane must fail
+    bad = [(q, digest, sig)] * 4
+    bad[2] = (q, digest, (sig[0], sig[1] ^ 2))
+    res = p256.verify_batch(bad)
+    assert list(res) == [True, True, False, True], "corrupted-lane self-check failed"
+
+    n_iter = 5
+    t0 = time.time()
+    for _ in range(n_iter):
+        out = p256.ecdsa_verify_kernel(*arrays)
+    out.block_until_ready()
+    dt = (time.time() - t0) / n_iter
+    return {
+        "ecdsa_batch": batch,
+        "ecdsa_ms_per_batch": round(dt * 1e3, 2),
+        "ecdsa_verifies_per_sec": batch / dt,
+        "ecdsa_compile_s": round(compile_s, 1),
+    }
+
+
+def bench_hmac(batch: int = 8192) -> dict:
+    from minbft_tpu.ops.hmac_sha256 import hmac_sign_kernel, hmac_verify_kernel
+
+    rng = np.random.default_rng(0)
+    keys = jax.device_put(jnp.asarray(rng.integers(0, 2**32, (batch, 8), dtype=np.uint32)))
+    msgs = jax.device_put(jnp.asarray(rng.integers(0, 2**32, (batch, 8), dtype=np.uint32)))
+    macs = hmac_sign_kernel(keys, msgs)
+    macs.block_until_ready()
+    out = hmac_verify_kernel(keys, msgs, macs)
+    assert bool(np.asarray(out).all())
+    n_iter = 20
+    t0 = time.time()
+    for _ in range(n_iter):
+        out = hmac_verify_kernel(keys, msgs, macs)
+    out.block_until_ready()
+    dt = (time.time() - t0) / n_iter
+    return {"hmac_batch": batch, "hmac_verifies_per_sec": batch / dt}
+
+
+async def _bench_cluster(n: int, f: int, n_requests: int) -> dict:
+    from minbft_tpu.client import new_client
+    from minbft_tpu.core import new_replica
+    from minbft_tpu.parallel import BatchVerifier
+    from minbft_tpu.sample.authentication import new_test_authenticators
+    from minbft_tpu.sample.config import SimpleConfiger
+    from minbft_tpu.sample.conn.inprocess import (
+        InProcessClientConnector,
+        InProcessPeerConnector,
+        make_testnet_stubs,
+    )
+    from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+    engines = [BatchVerifier(max_batch=64, max_delay=0.002) for _ in range(n)]
+    configer = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+    replica_auths, client_auths = new_test_authenticators(
+        n, n_clients=1, usig_kind="hmac", engines=engines, batch_signatures=False
+    )
+    stubs = make_testnet_stubs(n)
+    ledgers = [SimpleLedger() for _ in range(n)]
+    replicas = []
+    for i in range(n):
+        r = new_replica(
+            i, configer, replica_auths[i], InProcessPeerConnector(stubs), ledgers[i]
+        )
+        stubs[i].assign_replica(r)
+        replicas.append(r)
+    for r in replicas:
+        await r.start()
+    client = new_client(0, n, f, client_auths[0], InProcessClientConnector(stubs), seq_start=0)
+    await client.start()
+
+    # Warm the HMAC batch kernel shape before timing.
+    await asyncio.wait_for(client.request(b"warmup"), timeout=120)
+
+    t0 = time.time()
+    for k in range(n_requests):
+        await asyncio.wait_for(client.request(b"op-%d" % k), timeout=120)
+    dt = time.time() - t0
+
+    batch_stats = {}
+    for i, e in enumerate(engines):
+        for name, st in e.stats.items():
+            agg = batch_stats.setdefault(name, {"items": 0, "batches": 0})
+            agg["items"] += st.items
+            agg["batches"] += st.batches
+
+    await client.stop()
+    for r in replicas:
+        await r.stop()
+    assert all(lg.length >= n_requests for lg in ledgers)
+    return {
+        "e2e_n": n,
+        "e2e_f": f,
+        "e2e_requests": n_requests,
+        "e2e_committed_req_per_sec": n_requests / dt,
+        "e2e_batched_verifies": batch_stats.get("hmac_sha256", {}).get("items", 0),
+        "e2e_batches": batch_stats.get("hmac_sha256", {}).get("batches", 0),
+    }
+
+
+def main() -> None:
+    batch = int(os.environ.get("MINBFT_BENCH_BATCH", "4096"))
+    n_requests = int(os.environ.get("MINBFT_BENCH_REQUESTS", "200"))
+
+    extras = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+    if jax.default_backend() == "cpu":
+        # SIM mode: keep shapes tiny so the bench still completes.
+        batch = min(batch, 32)
+
+    extras.update(bench_hmac())
+    ecdsa = bench_ecdsa(batch)
+    extras.update(ecdsa)
+    if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
+        extras.update(asyncio.run(_bench_cluster(7, 3, n_requests)))
+
+    value = ecdsa["ecdsa_verifies_per_sec"]
+    out = {
+        "metric": "batched ECDSA-P256 verifies/sec/chip",
+        "value": round(value, 1),
+        "unit": "verifies/sec",
+        "vs_baseline": round(value / BASELINE_VERIFIES_PER_SEC, 3),
+    }
+    out.update(extras)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
