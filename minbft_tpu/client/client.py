@@ -3,16 +3,25 @@
 Reference structure (client/):
 
 - request pipeline: construct -> sign(ClientAuthen over AuthenBytes) ->
-  single-capacity request buffer -> broadcast stream to n sender tasks
-  (reference client/request.go:186-204, requestbuffer.go:59-88);
+  broadcast to n sender tasks (reference client/request.go:186-204,
+  requestbuffer.go:59-88);
 - per-replica connection task pair: outgoing pumps the request stream,
   incoming authenticates REPLYs (ReplicaAuthen + client-ID check,
   reference client/message-handling.go:161-170) and feeds the collector;
 - collector: f+1 matching replies by SHA256(result), dedup'd by replica ID
   (reference client/request.go:83-97, requestbuffer.go:219-236).
 
-The asyncio port keeps the one-request-in-flight-per-client gate as a lock
-(the reference blocks in AddRequest until the prior request is removed).
+Pipelining re-design: the reference gates one request in flight per client
+(requestbuffer.go:59-88 AddRequest blocks until the prior request is
+removed) because its replicas process a client's requests one sequence at a
+time anyway.  Here requests are tracked in a per-seq pending map, so a
+client may pipeline many requests; the replicas' clientstate still captures
+each client's sequences in order, but the network/verification latency of
+request k no longer serializes request k+1 — this is what lets the batch
+verification engine actually fill batches (the round-1 bench ran one
+request at a time and starved it).  ``max_inflight`` bounds the pipeline;
+an asyncio semaphore replaces the reference's single-slot buffer when set
+to 1.
 """
 
 from __future__ import annotations
@@ -27,12 +36,14 @@ from ..messages import Reply, Request, authen_bytes, marshal, unmarshal
 
 
 class _PendingRequest:
-    def __init__(self, seq: int, f: int):
+    __slots__ = ("seq", "f", "replies_by_replica", "count_by_digest", "result")
+
+    def __init__(self, seq: int, f: int, loop: asyncio.AbstractEventLoop):
         self.seq = seq
         self.f = f
         self.replies_by_replica: Dict[int, bytes] = {}
         self.count_by_digest: Dict[bytes, int] = {}
-        self.result: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.result: asyncio.Future = loop.create_future()
 
     def add_reply(self, reply: Reply) -> None:
         if reply.replica_id in self.replies_by_replica:
@@ -54,6 +65,8 @@ class Client:
         authenticator: api.Authenticator,
         connector: api.ReplicaConnector,
         seq_start: Optional[int] = None,
+        max_inflight: Optional[int] = None,
+        retransmit_interval: Optional[float] = None,
     ):
         if n < 2 * f + 1:
             raise ValueError(f"n must be at least 2f+1 (n={n}, f={f})")
@@ -65,8 +78,11 @@ class Client:
         # Sequence numbers seeded from wall clock so a restarted client
         # doesn't reuse sequences (reference client/request.go:209-217).
         self._seq = seq_start if seq_start is not None else time.time_ns()
-        self._seq_lock = asyncio.Lock()  # one request in flight per client
-        self._pending: Optional[_PendingRequest] = None
+        self._pending: Dict[int, _PendingRequest] = {}
+        self._inflight: Optional[asyncio.Semaphore] = (
+            asyncio.Semaphore(max_inflight) if max_inflight else None
+        )
+        self._retransmit_interval = retransmit_interval
         self._queues: Dict[int, asyncio.Queue] = {}
         self._tasks: list = []
         self._started = False
@@ -74,7 +90,7 @@ class Client:
     # -- connections --------------------------------------------------------
 
     async def start(self) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         for rid in range(self.n):
             handler = self._connector.replica_message_stream_handler(rid)
             if handler is None:
@@ -118,6 +134,9 @@ class Client:
         # Authenticate and attribute (reference client/message-handling.go:161-170).
         if msg.replica_id != replica_id or msg.client_id != self.client_id:
             return
+        pending = self._pending.get(msg.seq)
+        if pending is None or pending.result.done():
+            return
         try:
             await self._auth.verify_message_authen_tag(
                 api.AuthenticationRole.REPLICA,
@@ -127,35 +146,68 @@ class Client:
             )
         except api.AuthenticationError:
             return
-        pending = self._pending
-        if pending is not None and msg.seq == pending.seq:
+        # Re-fetch: the request may have resolved/retired during the await.
+        pending = self._pending.get(msg.seq)
+        if pending is not None:
             pending.add_reply(msg)
 
     # -- requests -----------------------------------------------------------
 
     async def request(self, operation: bytes, timeout: Optional[float] = None) -> bytes:
         """Submit an operation; resolves once f+1 replicas agree on the
-        result (reference client/client.go:66-71 Request)."""
+        result (reference client/client.go:66-71 Request).  Many requests
+        may be pipelined concurrently (bounded by ``max_inflight``)."""
         if not self._started:
             raise RuntimeError("client not started")
-        async with self._seq_lock:
+        if self._inflight is not None:
+            await self._inflight.acquire()
+        try:
             self._seq += 1
             seq = self._seq
             req = Request(client_id=self.client_id, seq=seq, operation=operation)
             req.signature = self._auth.generate_message_authen_tag(
                 api.AuthenticationRole.CLIENT, authen_bytes(req)
             )
-            pending = _PendingRequest(seq, self.f)
-            self._pending = pending
+            pending = _PendingRequest(seq, self.f, asyncio.get_running_loop())
+            self._pending[seq] = pending
             data = marshal(req)
-            for q in self._queues.values():
-                await q.put(data)
+            self._broadcast(data)
             try:
+                if self._retransmit_interval is not None:
+                    return await self._await_with_retransmit(pending, data, timeout)
                 if timeout is not None:
                     return await asyncio.wait_for(pending.result, timeout)
                 return await pending.result
             finally:
-                self._pending = None
+                self._pending.pop(seq, None)
+        finally:
+            if self._inflight is not None:
+                self._inflight.release()
+
+    def _broadcast(self, data: bytes) -> None:
+        for q in self._queues.values():
+            q.put_nowait(data)
+
+    async def _await_with_retransmit(
+        self, pending: _PendingRequest, data: bytes, timeout: Optional[float]
+    ) -> bytes:
+        """Periodically re-send the request until resolved — the network may
+        drop messages (the reference relies on its stream replay design,
+        core/message-handling.go:316-350 HELLO log replay, for the peer side;
+        clients get retransmission here)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            interval = self._retransmit_interval
+            if deadline is not None:
+                interval = min(interval, max(deadline - time.monotonic(), 0.001))
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(pending.result), interval
+                )
+            except asyncio.TimeoutError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                self._broadcast(data)
 
 
 def new_client(

@@ -41,7 +41,12 @@ def make_commit_validator(
             raise api.AuthenticationError(
                 "COMMIT must not come from the view's primary"
             )
-        await asyncio.gather(validate_prepare(prepare), verify_ui(commit))
+        # Sequential, not gathered: the embedded PREPARE was almost always
+        # validated when it arrived directly (verified-check memo), so the
+        # first await usually resolves without suspending and gather's task
+        # bookkeeping would be pure overhead on the hot path.
+        await validate_prepare(prepare)
+        await verify_ui(commit)
 
     return validate_commit
 
@@ -124,7 +129,10 @@ class CommitmentCollector:
                         self._next_exec_cv[view] = nxt + 1
                 if prepare is None:
                     return
-                await self._execute(prepare.request)
+                # A batched prepare commits atomically: its requests execute
+                # back-to-back in batch order on every replica.
+                for req in prepare.requests:
+                    await self._execute(req)
 
 
 def make_commitment_collector(

@@ -33,7 +33,7 @@ class StandardTimerProvider(TimerProvider):
     """Real-time timers on the running event loop."""
 
     def after(self, delay: float, callback: Callable[[], None]) -> Timer:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         return _StandardTimer(loop.call_later(delay, callback))
 
 

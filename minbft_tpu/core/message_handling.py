@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from collections import OrderedDict
 from typing import AsyncIterator, Dict, Optional
 
 from .. import api
@@ -48,6 +49,44 @@ from .internal.messagelog import MessageLog
 from .internal.peerstate import PeerStates
 from .internal.requestlist import RequestList
 from .internal.viewstate import ViewState
+
+
+class _PrepareBatcher:
+    """Groups the primary's captured requests into batched PREPAREs.
+
+    Request batching is an unimplemented roadmap item in the reference
+    (reference README.md:505, one request per PREPARE); here the primary
+    coalesces requests that arrive within the same event-loop turn (up to
+    ``max_batch``) into one PREPARE — one USIG counter value, one
+    PREPARE/COMMIT round, and one set of UI verifications for the whole
+    batch.  Ship-when-idle: a lone request flushes on the next loop turn,
+    so low-load latency is unchanged."""
+
+    def __init__(self, replica_id: int, handle_generated, max_batch: int = 64):
+        self.replica_id = replica_id
+        self.max_batch = max(1, max_batch)
+        self._handle_generated = handle_generated
+        self._buffers: Dict[int, list] = {}  # view -> pending requests
+
+    async def propose(self, request: Request, view: int) -> None:
+        buf = self._buffers.setdefault(view, [])
+        buf.append(request)
+        if len(buf) >= self.max_batch:
+            self._flush(view)
+        elif len(buf) == 1:
+            asyncio.get_running_loop().call_soon(self._flush, view)
+
+    def _flush(self, view: int) -> None:
+        buf = self._buffers.get(view)
+        if not buf:
+            return
+        self._buffers[view] = []
+        prepare = Prepare(
+            replica_id=self.replica_id, view=view, requests=tuple(buf)
+        )
+        # UI assignment order = task creation order (handle_generated's UI
+        # lock wakes waiters FIFO), so batches hit the log in flush order.
+        asyncio.get_running_loop().create_task(self._handle_generated(prepare))
 
 
 class Handlers:
@@ -81,6 +120,29 @@ class Handlers:
         self.pending = RequestList()
         self._ui_lock = asyncio.Lock()
 
+        # Verified-check memo: a COMMIT re-validates its embedded PREPARE
+        # (which re-validates the embedded REQUEST), so the same
+        # (authen-bytes, tag) pair is verified up to n times per request.
+        # Verification is a pure function of those bytes — a passed check is
+        # cached (LRU), turning O(n²) verifies per request into O(n).
+        # Failures are never cached.  (The reference re-verifies every time,
+        # core/commit.go:74-92; this memo preserves its exact semantics.)
+        self._verified: "OrderedDict[tuple, None]" = OrderedDict()
+        self._verified_cap = 4 * 4096
+
+        def _verified_hit(key: tuple) -> bool:
+            cache = self._verified
+            if key in cache:
+                cache.move_to_end(key)
+                return True
+            return False
+
+        def _verified_put(key: tuple) -> None:
+            cache = self._verified
+            cache[key] = None
+            if len(cache) > self._verified_cap:
+                cache.popitem(last=False)
+
         # --- signing / verification primitives
         def sign_message(msg) -> None:
             msg.signature = authenticator.generate_message_authen_tag(
@@ -89,13 +151,32 @@ class Handlers:
 
         async def verify_signature(msg) -> None:
             peer = msg.client_id if isinstance(msg, Request) else msg.replica_id
+            role = utils.signing_role(msg)
+            ab = authen_bytes(msg)
+            key = (role, peer, ab, msg.signature)
+            if _verified_hit(key):
+                return
             await authenticator.verify_message_authen_tag(
-                utils.signing_role(msg), peer, authen_bytes(msg), msg.signature
+                role, peer, ab, msg.signature
             )
+            _verified_put(key)
+
+        base_verify_ui = usig_ui.make_ui_verifier(authenticator)
+
+        async def verify_ui(msg):
+            ui = msg.ui
+            if ui is None:
+                raise api.AuthenticationError("missing UI")
+            key = ("ui", msg.replica_id, authen_bytes(msg), ui.counter, ui.cert)
+            if _verified_hit(key):
+                return ui
+            ui = await base_verify_ui(msg)
+            _verified_put(key)
+            return ui
 
         self.sign_message = sign_message
         self.verify_signature = verify_signature
-        self.verify_ui = usig_ui.make_ui_verifier(authenticator)
+        self.verify_ui = verify_ui
         self.assign_ui = usig_ui.make_ui_assigner(authenticator)
         self.capture_ui = usig_ui.make_ui_capturer(self.peer_states)
 
@@ -114,7 +195,7 @@ class Handlers:
                 self.log.warning(
                     "request timeout for client %d seq %d", req.client_id, req.seq
                 )
-                asyncio.get_event_loop().create_task(
+                asyncio.get_running_loop().create_task(
                     self.handle_request_timeout(view)
                 )
 
@@ -168,14 +249,16 @@ class Handlers:
             add_reply,
         )
 
-        def new_prepare(view: int, req: Request) -> Prepare:
-            return Prepare(replica_id=replica_id, view=view, request=req)
+        self._prepare_batcher = _PrepareBatcher(
+            replica_id,
+            self.handle_generated,
+            max_batch=getattr(configer, "batchsize_prepare", 64),
+        )
 
         self.apply_request = request_mod.make_request_applier(
             replica_id,
             n,
-            self.handle_generated,
-            new_prepare,
+            self._prepare_batcher.propose,
             start_prepare_timer,
             start_request_timer,
         )
@@ -267,7 +350,8 @@ class Handlers:
         # Process embedded messages first (reference processEmbedded,
         # core/message-handling.go:454-473).
         if isinstance(msg, Prepare):
-            await self.process_request(msg.request)
+            for req in msg.requests:
+                await self.process_request(req)
         elif isinstance(msg, Commit):
             await self._process_peer_message(msg.prepare)
 
@@ -320,6 +404,76 @@ class Handlers:
 # Stream pumps.
 
 
+def _wire_bytes(msg: Message) -> bytes:
+    """Marshal with per-object memo.  Only used for messages already in a
+    message log (final — UIs/signatures assigned), which are re-marshalled
+    once per subscribed peer stream."""
+    cached = msg.__dict__.get("_wire_bytes")
+    if cached is None:
+        cached = marshal(msg)
+        msg.__dict__["_wire_bytes"] = cached
+    return cached
+
+
+# Upper bound on concurrently-processed messages per incoming stream: enough
+# that per-peer in-order UI capture (which may briefly park a task) never
+# stalls the pipeline, small enough to bound memory under a message flood.
+_STREAM_CONCURRENCY = 1024
+
+
+class _ConcurrentStreamProcessor:
+    """Handle each incoming message in its own task.
+
+    The reference dedicates one goroutine per stream and processes messages
+    serially (core/message-handling.go:204-246).  Serial processing defeats
+    batched verification: message k+1's (stateless) validation cannot start
+    until message k's full validate+process finishes, so verification
+    batches never fill.  Here validation runs concurrently across messages
+    — per-peer processing *order* is still enforced downstream by the
+    in-order UI capture (peerstate) and per-client seq capture
+    (clientstate), exactly the batching-vs-ordering split of SURVEY.md §7.
+    """
+
+    def __init__(self, handle, on_error):
+        self._handle = handle
+        self._on_error = on_error
+        self._sem = asyncio.Semaphore(_STREAM_CONCURRENCY)
+        self._tasks: set = set()
+
+    async def submit(self, data: bytes) -> None:
+        await self._sem.acquire()
+        task = asyncio.get_running_loop().create_task(self._run(data, None))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def submit_msg(self, msg: Message) -> None:
+        await self._sem.acquire()
+        task = asyncio.get_running_loop().create_task(self._run(None, msg))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run(self, data: Optional[bytes], msg: Optional[Message]) -> None:
+        try:
+            if msg is None:
+                msg = unmarshal(data)
+            await self._handle(msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._on_error(e)
+        finally:
+            self._sem.release()
+
+    async def drain(self) -> None:
+        """Wait for every in-flight message task to finish."""
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+
+    def cancel(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+
 class PeerStreamHandler(api.MessageStreamHandler):
     """Server side of a peer connection: expect HELLO, then stream the
     broadcast log + the hello sender's unicast log
@@ -347,29 +501,33 @@ class PeerStreamHandler(api.MessageStreamHandler):
             async for msg in log.stream(done):
                 await queue.put(msg)
 
-        tasks = [asyncio.get_event_loop().create_task(pump(h.message_log))]
+        loop = asyncio.get_running_loop()
+        tasks = [loop.create_task(pump(h.message_log))]
         ulog = h.unicast_logs.get(peer_id)
         if ulog is not None:
-            tasks.append(asyncio.get_event_loop().create_task(pump(ulog)))
+            tasks.append(loop.create_task(pump(ulog)))
 
         # Also consume (and process) any further messages the peer sends on
-        # this stream (the reference's separate incoming direction).
+        # this stream (the reference's separate incoming direction) — each
+        # in its own task so their validations co-batch.
+        proc = _ConcurrentStreamProcessor(
+            h.handle_peer_message,
+            lambda e: h.log.warning("dropping peer message: %s", e),
+        )
+
         async def consume_incoming() -> None:
             async for data in in_stream:
-                try:
-                    msg = unmarshal(data)
-                    await h.handle_peer_message(msg)
-                except Exception as e:  # drop invalid peer messages
-                    h.log.warning("dropping peer message: %s", e)
+                await proc.submit(data)
 
-        tasks.append(asyncio.get_event_loop().create_task(consume_incoming()))
+        tasks.append(loop.create_task(consume_incoming()))
 
         try:
             while True:
                 msg = await queue.get()
-                yield marshal(msg)
+                yield _wire_bytes(msg)
         finally:
             done.set()
+            proc.cancel()
             for t in tasks:
                 t.cancel()
 
@@ -388,26 +546,26 @@ class ClientStreamHandler(api.MessageStreamHandler):
         out_queue: asyncio.Queue = asyncio.Queue()
         FIN = object()
 
-        async def handle_one(data: bytes) -> None:
-            try:
-                msg = unmarshal(data)
-                reply = await h.handle_client_message(msg)
-                await out_queue.put(marshal(reply))
-            except Exception as e:
-                h.log.warning("dropping client message: %s", e)
+        async def handle_one(msg: Message) -> None:
+            reply = await h.handle_client_message(msg)
+            await out_queue.put(marshal(reply))
+
+        # Requests are handled concurrently (replies may take a quorum
+        # round-trip each, and a pipelined client sends many requests per
+        # stream), bounded + pruned by the stream processor so a request
+        # flood cannot grow replica memory without bound.
+        proc = _ConcurrentStreamProcessor(
+            handle_one,
+            lambda e: h.log.warning("dropping client message: %s", e),
+        )
 
         async def consume() -> None:
-            tasks = []
             async for data in in_stream:
-                # Requests are handled concurrently: replies may take a
-                # quorum round-trip each, and a client may pipeline
-                # requests for different clients over one stream.
-                tasks.append(asyncio.get_event_loop().create_task(handle_one(data)))
-            if tasks:
-                await asyncio.gather(*tasks, return_exceptions=True)
+                await proc.submit(data)
+            await proc.drain()
             await out_queue.put(FIN)
 
-        consumer_task = asyncio.get_event_loop().create_task(consume())
+        consumer_task = asyncio.get_running_loop().create_task(consume())
         try:
             while True:
                 item = await out_queue.get()
@@ -428,12 +586,26 @@ async def _anext(ait: AsyncIterator[bytes]) -> Optional[bytes]:
 async def run_own_message_loop(handlers: Handlers, done: asyncio.Event) -> None:
     """Self-delivery of own generated messages (reference
     handleOwnPeerMessages, core/message-handling.go:294-302): this is how
-    the primary counts its own PREPARE and a backup its own COMMIT."""
-    async for msg in handlers.message_log.stream(done):
-        try:
-            await handlers.handle_own_message(msg)
-        except Exception:
-            handlers.log.exception("own-message processing failed")
+    the primary counts its own PREPARE and a backup its own COMMIT.
+
+    Each own message is processed in its own task: an own COMMIT embeds the
+    *primary's* PREPARE, whose in-order capture may need to wait for an
+    earlier primary message still in flight — that wait must not
+    head-of-line-block self-delivery of subsequent own messages (own-CV
+    order is still enforced by peerstate capture on our own UIs)."""
+
+    async def handle(msg: Message) -> None:
+        await handlers.handle_own_message(msg)
+
+    proc = _ConcurrentStreamProcessor(
+        handle,
+        lambda e: handlers.log.error("own-message processing failed: %r", e),
+    )
+    try:
+        async for msg in handlers.message_log.stream(done):
+            await proc.submit_msg(msg)
+    finally:
+        proc.cancel()
 
 
 async def run_peer_connection(

@@ -31,10 +31,12 @@ def make_prepare_validator(
                 f"PREPARE from non-primary replica {prepare.replica_id} "
                 f"in view {prepare.view}"
             )
-        # Client signature on the embedded request + primary's UI, batched
-        # together (the reference does these serially, prepare.go:55-61).
+        # Client signatures on every embedded request + the primary's UI,
+        # batched into one engine round (the reference does these serially,
+        # prepare.go:55-61).
         await asyncio.gather(
-            validate_request(prepare.request), verify_ui(prepare)
+            *[validate_request(r) for r in prepare.requests],
+            verify_ui(prepare),
         )
 
     return validate_prepare
@@ -50,8 +52,9 @@ def make_prepare_applier(
     """Reference makePrepareApplier (core/prepare.go:69-94)."""
 
     async def apply_prepare(prepare: Prepare) -> None:
-        prepare_seq(prepare.request)
-        stop_prepare_timer(prepare.request)
+        for req in prepare.requests:
+            prepare_seq(req)
+            stop_prepare_timer(req)
         await collect_commitment(prepare.replica_id, prepare)
         if prepare.replica_id != replica_id:
             # A backup commits to the accepted proposal

@@ -73,7 +73,7 @@ class _Replica(api.Replica):
         return message_handling.ClientStreamHandler(self.handlers)
 
     async def start(self) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         self._tasks.append(
             loop.create_task(
                 message_handling.run_own_message_loop(self.handlers, self._done)

@@ -53,20 +53,20 @@ def make_request_processor(
 def make_request_applier(
     replica_id: int,
     n: int,
-    handle_generated,
-    new_prepare,
+    propose,
     start_prepare_timer,
     start_request_timer,
 ) -> Callable[[Request, int], Awaitable[None]]:
     """Apply a captured REQUEST in a view (reference makeRequestApplier,
-    core/request.go:180-198): the primary proposes a PREPARE; a backup
-    starts the prepare timer (forward-to-primary fallback) — both start
-    the request (view-change) timer."""
+    core/request.go:180-198): the primary proposes the request for a
+    (batched) PREPARE; a backup starts the prepare timer
+    (forward-to-primary fallback) — both start the request (view-change)
+    timer."""
 
     async def apply_request(request: Request, view: int) -> None:
         start_request_timer(request, view)
         if utils.is_primary(view, replica_id, n):
-            await handle_generated(new_prepare(view, request))
+            await propose(request, view)
         else:
             start_prepare_timer(request, view)
 

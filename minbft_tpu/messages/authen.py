@@ -38,7 +38,22 @@ def _sha256(b: bytes) -> bytes:
 
 def authen_bytes(m: Message) -> bytes:
     """Canonical bytes a signature / UI certificate for ``m`` covers
-    (reference messages/authen.go:27-82)."""
+    (reference messages/authen.go:27-82).
+
+    Memoized per message object: every field covered is final by the time
+    the first caller needs these bytes (signatures/UIs are excluded from
+    their own message's authen bytes; a COMMIT's embedded prepare already
+    carries its UI when the COMMIT is constructed), and the same message is
+    re-authenticated at several pipeline stages."""
+    cached = m.__dict__.get("_authen_bytes")
+    if cached is not None:
+        return cached
+    ab = _authen_bytes(m)
+    m.__dict__["_authen_bytes"] = ab
+    return ab
+
+
+def _authen_bytes(m: Message) -> bytes:
     if isinstance(m, Request):
         return (
             b"REQUEST"
@@ -55,13 +70,17 @@ def authen_bytes(m: Message) -> bytes:
             + _sha256(m.result)
         )
     if isinstance(m, Prepare):
-        # Covers the embedded request *with* its client signature, so the
-        # primary's UI authenticates the exact bytes it ordered.
+        # Covers every embedded request *with* its client signature (in
+        # batch order), so the primary's UI authenticates the exact bytes —
+        # and the exact order — it proposed.
+        h = hashlib.sha256()
+        for r in m.requests:
+            h.update(codec.marshal(r))
         return (
             b"PREPARE"
             + _U32.pack(m.replica_id)
             + _U64.pack(m.view)
-            + _sha256(codec.marshal(m.request))
+            + h.digest()
         )
     if isinstance(m, Commit):
         if m.prepare.ui is None:

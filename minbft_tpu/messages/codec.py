@@ -131,7 +131,8 @@ def marshal(m: Message) -> bytes:
             bytes([_TAG_PREPARE])
             + _pack_u32(m.replica_id)
             + _pack_u64(m.view)
-            + _pack_bytes(marshal(m.request))
+            + _pack_u32(len(m.requests))
+            + b"".join(_pack_bytes(marshal(r)) for r in m.requests)
             + _pack_ui(m.ui)
         )
     if isinstance(m, Commit):
@@ -187,13 +188,19 @@ def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
     if tag == _TAG_PREPARE:
         rid, off = _read_u32(data, off)
         view, off = _read_u64(data, off)
-        reqb, off = _read_bytes(data, off)
+        count, off = _read_u32(data, off)
+        if count == 0:
+            raise CodecError("PREPARE must embed at least one REQUEST")
+        reqs = []
+        for _ in range(count):
+            reqb, off = _read_bytes(data, off)
+            req = unmarshal(reqb)
+            if not isinstance(req, Request):
+                raise CodecError("PREPARE must embed REQUESTs")
+            reqs.append(req)
         uib, off = _read_bytes(data, off)
-        req = unmarshal(reqb)
-        if not isinstance(req, Request):
-            raise CodecError("PREPARE must embed a REQUEST")
         ui = _parse_ui(uib)
-        return Prepare(replica_id=rid, view=view, request=req, ui=ui), off
+        return Prepare(replica_id=rid, view=view, requests=reqs, ui=ui), off
     if tag == _TAG_COMMIT:
         rid, off = _read_u32(data, off)
         prepb, off = _read_bytes(data, off)

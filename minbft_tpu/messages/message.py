@@ -19,7 +19,7 @@ matters because signatures and USIG certificates are computed over
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 
 @dataclasses.dataclass
@@ -92,16 +92,47 @@ class Reply(Message):
     signature: bytes = b""
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(init=False)
 class Prepare(Message):
-    """Primary's ordering proposal for one request, certified by the
-    primary's USIG (reference messages/api.go:58-65)."""
+    """Primary's ordering proposal for a **batch** of requests, certified by
+    the primary's USIG (reference messages/api.go:58-65).
+
+    The reference orders one request per PREPARE; request batching is an
+    explicitly unimplemented roadmap item there (reference README.md:505).
+    Here a PREPARE carries an ordered tuple of requests assigned to one
+    USIG counter value: the batch commits atomically and executes in list
+    order, amortizing the PREPARE/COMMIT round (and its UI verifications)
+    over the whole batch.  A single-request PREPARE (``request=`` keyword)
+    is the degenerate batch, keeping reference-shaped call sites working.
+    """
 
     KIND = "PREPARE"
     replica_id: int
     view: int
-    request: Request
+    requests: Tuple[Request, ...]
     ui: Optional[UI] = None
+
+    def __init__(
+        self,
+        replica_id: int,
+        view: int,
+        request: Optional[Request] = None,
+        ui: Optional[UI] = None,
+        requests: Optional[Sequence[Request]] = None,
+    ):
+        if (request is None) == (requests is None):
+            raise ValueError("pass exactly one of request= / requests=")
+        self.replica_id = replica_id
+        self.view = view
+        self.requests = (request,) if request is not None else tuple(requests)
+        if not self.requests:
+            raise ValueError("PREPARE must order at least one request")
+        self.ui = ui
+
+    @property
+    def request(self) -> Request:
+        """The first (often only) request of the batch."""
+        return self.requests[0]
 
 
 @dataclasses.dataclass

@@ -17,9 +17,10 @@ def stringify(m: Message) -> str:
         )
     if isinstance(m, Prepare):
         cv = m.ui.counter if m.ui else None
+        reqs = ", ".join(stringify(r) for r in m.requests)
         return (
             f"<PREPARE cv={cv} replica={m.replica_id} view={m.view} "
-            f"request={stringify(m.request)}>"
+            f"requests=[{reqs}]>"
         )
     if isinstance(m, Commit):
         cv = m.ui.counter if m.ui else None

@@ -6,10 +6,13 @@ called from core/message-handling.go:409-452 and core/usig-ui.go:62-73).
 Here, each protocol task awaits ``BatchVerifier.verify_*`` and the engine:
 
 1. appends the item to the scheme's pending queue,
-2. flushes the queue when it reaches ``max_batch`` items **or** when the
-   oldest item has waited ``max_delay`` seconds (adaptive flush — a single
-   low-load request never stalls waiting for a batch to fill; this is the
-   latency mitigation from SURVEY.md §7 "hard parts"),
+2. flushes by a **ship-when-idle** policy: if no kernel dispatch is in
+   flight, the queue flushes on the next event-loop turn (a lone low-load
+   verification never stalls waiting for a batch to fill — the latency
+   mitigation from SURVEY.md §7 "hard parts"); while a dispatch *is* in
+   flight, items accumulate and flush the moment it completes, so batch
+   sizes self-scale to arrival-rate × device-latency (high load fills
+   batches with no tuning knob),
 3. pads the batch to a fixed bucket size (one compiled kernel per bucket,
    never a recompile from a data-dependent shape),
 4. dispatches the jitted kernel on a worker thread (keeping the event loop
@@ -53,14 +56,15 @@ class VerifyStats:
 
 
 class _SchemeQueue:
-    """Pending verifications for one scheme, with adaptive flush."""
+    """Pending verifications for one scheme, with ship-when-idle flush."""
 
     def __init__(self, engine: "BatchVerifier", name: str, dispatch):
         self.engine = engine
         self.name = name
         self.dispatch = dispatch  # List[item] -> np.ndarray[bool]
         self.pending: List[Tuple[object, asyncio.Future]] = []
-        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._flush_handle: Optional[asyncio.Handle] = None
+        self.inflight = 0
         self.stats = VerifyStats()
 
     def submit(self, item) -> asyncio.Future:
@@ -69,20 +73,29 @@ class _SchemeQueue:
         self.pending.append((item, fut))
         if len(self.pending) >= self.engine.max_batch:
             self._flush_now()
-        elif self._flush_handle is None:
-            self._flush_handle = loop.call_later(
-                self.engine.max_delay, self._flush_now
-            )
+        elif self.inflight == 0 and self._flush_handle is None:
+            # Device idle: flush on the next loop turn (after every
+            # already-runnable coroutine has had the chance to co-submit),
+            # optionally stretched by max_delay to coalesce more.
+            if self.engine.max_delay > 0:
+                self._flush_handle = loop.call_later(
+                    self.engine.max_delay, self._flush_now
+                )
+            else:
+                self._flush_handle = loop.call_soon(self._flush_now)
+        # else: a dispatch is in flight — accumulate; its completion flushes.
         return fut
 
     def _flush_now(self) -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
-        if not self.pending:
-            return
-        batch, self.pending = self.pending, []
-        asyncio.get_running_loop().create_task(self._run(batch))
+        max_batch = self.engine.max_batch
+        while self.pending and self.inflight < self.engine.max_inflight:
+            batch = self.pending[:max_batch]
+            del self.pending[:max_batch]
+            self.inflight += 1
+            asyncio.get_running_loop().create_task(self._run(batch))
 
     async def _run(self, batch) -> None:
         items = [it for it, _ in batch]
@@ -94,6 +107,10 @@ class _SchemeQueue:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        finally:
+            self.inflight -= 1
+            if self.pending:
+                self._flush_now()
         dt = time.monotonic() - t0
         st = self.stats
         st.items += len(batch)
@@ -113,24 +130,45 @@ class BatchVerifier:
     ``ed25519`` (items: (pub32, msg, sig64) bytes).
 
     ``max_batch`` bounds the device batch (and the largest compiled bucket);
-    ``max_delay`` bounds the latency a lone verification can suffer waiting
-    for co-batching.
+    ``max_delay`` optionally stretches the idle-device flush to coalesce
+    more items (0 = flush on the next event-loop turn); ``max_inflight``
+    bounds concurrent kernel dispatches per scheme (2 keeps the device fed
+    while the next batch accumulates).
     """
 
     def __init__(
         self,
         max_batch: int = 512,
-        max_delay: float = 0.002,
+        max_delay: float = 0.0,
         buckets: Optional[Sequence[int]] = None,
+        max_inflight: int = 2,
     ):
         self.max_batch = max_batch
         self.max_delay = max_delay
-        # Default: ONE padded shape.  Every distinct bucket size is a
-        # separate (expensive) kernel compilation; padding a short batch to
-        # max_batch costs far less than a recompile, and one shape keeps
-        # warm-up deterministic.  Pass explicit buckets to trade padding
-        # work for more compiled shapes.
-        self.buckets = tuple(buckets) if buckets else (max_batch,)
+        self.max_inflight = max_inflight
+        # Default: a small geometric ladder of padded shapes (8, 32, 128,
+        # ..., max_batch).  Each distinct bucket size is a separate kernel
+        # compilation, but padding a batch of 3 to max_batch=512 wastes
+        # ~170x device compute — the ladder bounds pad waste at 4x while
+        # keeping the shape count logarithmic.  Pass explicit buckets (e.g.
+        # ``(max_batch,)``) when compilation is the scarcer resource (the
+        # unrolled ECDSA kernel).
+        if buckets:
+            self.buckets = tuple(buckets)
+        else:
+            ladder = []
+            b = 8
+            while b < max_batch:
+                ladder.append(b)
+                b *= 4
+            ladder.append(max_batch)
+            self.buckets = tuple(ladder)
+        if self.buckets[-1] < max_batch:
+            # An explicit bucket list smaller than max_batch would hand the
+            # dispatchers an unplanned data-dependent shape (ADVICE r1).
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} < max_batch {max_batch}"
+            )
         self._queues: Dict[str, _SchemeQueue] = {}
 
     # -- queues -------------------------------------------------------------

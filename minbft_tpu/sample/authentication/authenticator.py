@@ -213,6 +213,7 @@ def new_test_authenticators(
     engine: Optional[BatchVerifier] = None,
     engines: Optional[list] = None,
     batch_signatures: bool = True,
+    client_engine: Optional[BatchVerifier] = None,
 ):
     """Generate a coherent set of authenticators for an in-process testnet
     (the reference's GenerateTestnetKeys equivalent,
@@ -260,7 +261,9 @@ def new_test_authenticators(
             client_priv=client_keys[i][0],
             replica_pubs=replica_pubs,
             client_pubs=client_pubs,
-            engine=None,  # clients verify replies serially (cheap, f+1 small)
+            # Default None: clients verify replies serially (f+1 is small).
+            # Pass client_engine to co-batch REPLY verification on TPU.
+            engine=client_engine,
         )
         for i in range(n_clients)
     ]

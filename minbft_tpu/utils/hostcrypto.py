@@ -1,30 +1,59 @@
-"""Host-side elliptic-curve crypto with Python big ints.
+"""Host-side elliptic-curve crypto.
 
-Pure-Python P-256 and Ed25519: key generation, signing (RFC 6979
-deterministic nonces for ECDSA), and a reference verifier.  Three jobs:
+P-256 and Ed25519: key generation, signing (RFC 6979 deterministic nonces
+for ECDSA), and a reference verifier.  Three jobs:
 
 1. **Signing** — replicas/clients sign with host code (one signature per
    outgoing message; generation is inherently serial per-key because the
    USIG counter must increment atomically, reference usig/sgx/enclave/
-   usig.c:66-69).  A faster C++ implementation lives in
-   ``minbft_tpu/native`` and is preferred when built; this module is the
-   always-available fallback and the semantic reference.
+   usig.c:66-69).
 2. **Differential testing** — the TPU kernels (:mod:`minbft_tpu.ops.p256`,
-   :mod:`minbft_tpu.ops.ed25519`) are tested bit-for-bit against these
-   functions on random and adversarial inputs.
+   :mod:`minbft_tpu.ops.ed25519`) are tested bit-for-bit against the
+   pure-Python functions here on random and adversarial inputs.
 3. **Key generation** for the keystore/keytool (reference
    sample/authentication/keymanager.go:404-450).
 
-Standard-library only (hashlib, hmac, secrets): nothing here may depend on
-packages that are not baked into the image.
+Two tiers:
+
+- A **pure-Python big-int implementation** (always available, standard
+  library only) — the semantic reference the TPU kernels are diff-tested
+  against, and the fallback everywhere else.
+- An **OpenSSL-backed fast path** through the ``cryptography`` package for
+  the hot host-side operations (sign/verify/public-key derivation), ~500x
+  the pure-Python speed.  ECDSA signing via OpenSSL uses random nonces
+  rather than RFC 6979 — both are valid ECDSA; use ``ecdsa_sign_py`` where
+  deterministic output matters.  The Ed25519 *verifier* stays pure-Python
+  by default because its cofactored acceptance semantics (8sB == 8R + 8kA)
+  are the oracle the batch kernel mirrors; OpenSSL's cofactorless check
+  may disagree on adversarial small-order inputs.
 """
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import hmac
 import secrets
 from typing import Tuple
+
+try:  # OpenSSL fast path (baked into the image via `cryptography`)
+    from cryptography.exceptions import InvalidSignature as _InvalidSignature
+    from cryptography.hazmat.primitives import hashes as _ossl_hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as _ossl_ec
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ossl_ed
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed as _Prehashed,
+    )
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature as _decode_dss,
+    )
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature as _encode_dss,
+    )
+
+    _HAVE_OSSL = True
+except Exception:  # pragma: no cover - image always has cryptography
+    _HAVE_OSSL = False
 
 # ---------------------------------------------------------------------------
 # NIST P-256.
@@ -84,9 +113,25 @@ def scalar_mult(k: int, p: PointA):
     return acc
 
 
+if _HAVE_OSSL:
+    _OSSL_CURVE = _ossl_ec.SECP256R1()
+    _OSSL_SHA256 = _ossl_ec.ECDSA(_Prehashed(_ossl_hashes.SHA256()))
+
+    @functools.lru_cache(maxsize=4096)
+    def _ossl_priv(d: int):
+        return _ossl_ec.derive_private_key(d, _OSSL_CURVE)
+
+    @functools.lru_cache(maxsize=4096)
+    def _ossl_pub(x: int, y: int):
+        return _ossl_ec.EllipticCurvePublicNumbers(x, y, _OSSL_CURVE).public_key()
+
+
 def keygen(rng=None) -> Tuple[int, PointA]:
     """-> (private scalar d, public point Q = d*G)."""
     d = (rng or secrets).randbelow(N - 1) + 1
+    if _HAVE_OSSL:
+        nums = _ossl_priv(d).public_key().public_numbers()
+        return d, (nums.x, nums.y)
     return d, scalar_mult(d, (GX, GY))
 
 
@@ -110,8 +155,9 @@ def _rfc6979_k(d: int, z: int, order: int = N) -> int:
         v = hmac.new(k, v, hashlib.sha256).digest()
 
 
-def ecdsa_sign(d: int, digest: bytes) -> Tuple[int, int]:
-    """ECDSA-P256 over a 32-byte digest -> (r, s). Deterministic (RFC 6979)."""
+def ecdsa_sign_py(d: int, digest: bytes) -> Tuple[int, int]:
+    """Pure-Python ECDSA-P256 over a 32-byte digest -> (r, s).
+    Deterministic (RFC 6979)."""
     z = int.from_bytes(digest[:32], "big") % N
     while True:
         k = _rfc6979_k(d, z)
@@ -127,8 +173,17 @@ def ecdsa_sign(d: int, digest: bytes) -> Tuple[int, int]:
         return r, s
 
 
-def ecdsa_verify(q: PointA, digest: bytes, sig: Tuple[int, int]) -> bool:
-    """Reference verifier (host big ints) — the oracle for the TPU kernel."""
+def ecdsa_sign(d: int, digest: bytes) -> Tuple[int, int]:
+    """ECDSA-P256 over a 32-byte digest -> (r, s).  OpenSSL when available
+    (random nonce), pure Python otherwise (RFC 6979)."""
+    if _HAVE_OSSL:
+        der = _ossl_priv(d).sign(digest[:32], _OSSL_SHA256)
+        return _decode_dss(der)
+    return ecdsa_sign_py(d, digest)
+
+
+def ecdsa_verify_py(q: PointA, digest: bytes, sig: Tuple[int, int]) -> bool:
+    """Pure-Python reference verifier — the oracle for the TPU kernel."""
     r, s = sig
     if not (0 < r < N and 0 < s < N):
         return False
@@ -140,6 +195,26 @@ def ecdsa_verify(q: PointA, digest: bytes, sig: Tuple[int, int]) -> bool:
     if pt is None:
         return False
     return pt[0] % N == r
+
+
+def ecdsa_verify(q: PointA, digest: bytes, sig: Tuple[int, int]) -> bool:
+    """ECDSA-P256 verify.  OpenSSL when available, pure Python otherwise
+    (identical accept/reject behavior for on-curve keys; OpenSSL
+    additionally rejects off-curve public keys at load)."""
+    r, s = sig
+    if not (0 < r < N and 0 < s < N):
+        return False
+    if _HAVE_OSSL:
+        try:
+            pub = _ossl_pub(*q)
+        except ValueError:
+            return False  # off-curve / out-of-range public key
+        try:
+            pub.verify(_encode_dss(r, s), digest[:32], _OSSL_SHA256)
+            return True
+        except _InvalidSignature:
+            return False
+    return ecdsa_verify_py(q, digest, sig)
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +232,10 @@ def _ed_recover_x(y: int, sign: int):
     if (x * x - xx) % ED_P != 0:
         x = x * pow(2, (ED_P - 1) // 4, ED_P) % ED_P
     if (x * x - xx) % ED_P != 0:
+        return None
+    if x == 0 and sign == 1:
+        # RFC 8032 §5.1.3 step 4: x = 0 with the sign bit set is a
+        # non-canonical encoding and must be rejected.
         return None
     if x & 1 != sign:
         x = ED_P - x
@@ -213,9 +292,26 @@ def ed_decompress(data: bytes):
     return (x, y, 1, x * y % ED_P)
 
 
+if _HAVE_OSSL:
+
+    @functools.lru_cache(maxsize=4096)
+    def _ossl_ed_priv(seed: bytes):
+        return _ossl_ed.Ed25519PrivateKey.from_private_bytes(seed)
+
+
 def ed25519_keygen(seed: bytes | None = None) -> Tuple[bytes, bytes]:
     """-> (seed32, public key 32B compressed)."""
     seed = seed if seed is not None else secrets.token_bytes(32)
+    if _HAVE_OSSL:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        pub = _ossl_ed_priv(seed).public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+        return seed, pub
     h = hashlib.sha512(seed).digest()
     a = int.from_bytes(h[:32], "little")
     a &= (1 << 254) - 8
@@ -223,7 +319,7 @@ def ed25519_keygen(seed: bytes | None = None) -> Tuple[bytes, bytes]:
     return seed, ed_compress(ed_scalar_mult(a, ED_BASE))
 
 
-def ed25519_sign(seed: bytes, msg: bytes) -> bytes:
+def ed25519_sign_py(seed: bytes, msg: bytes) -> bytes:
     h = hashlib.sha512(seed).digest()
     a = int.from_bytes(h[:32], "little")
     a &= (1 << 254) - 8
@@ -234,6 +330,14 @@ def ed25519_sign(seed: bytes, msg: bytes) -> bytes:
     k = int.from_bytes(hashlib.sha512(rp + pub + msg).digest(), "little") % ED_L
     s = (r + k * a) % ED_L
     return rp + s.to_bytes(32, "little")
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> bytes:
+    """RFC 8032 Ed25519 signing (deterministic — OpenSSL and the pure
+    implementation produce identical signatures)."""
+    if _HAVE_OSSL:
+        return _ossl_ed_priv(seed).sign(msg)
+    return ed25519_sign_py(seed, msg)
 
 
 def ed25519_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:

@@ -1,0 +1,275 @@
+"""Direct unit tests for the core internal state packages.
+
+Mirrors the reference's state-package test tier (SURVEY.md §4 tier 2):
+clientstate blocking capture/release/retire
+(reference core/internal/clientstate/request-seq_test.go), peerstate
+in-order capture including the waiting case (peerstate_test.go:28-105), and
+messagelog concurrent append/stream (messagelog_test.go:29-117).
+"""
+
+import asyncio
+
+import pytest
+
+from minbft_tpu.core.internal.clientstate import ClientState, ClientStates
+from minbft_tpu.core.internal.messagelog import MessageLog
+from minbft_tpu.core.internal.peerstate import PeerState, PeerStates
+from minbft_tpu.core.internal.timer import FakeTimerProvider
+from minbft_tpu.core.internal.viewstate import ViewState
+
+
+# ---------------------------------------------------------------------------
+# clientstate
+
+
+def test_clientstate_capture_release_retire():
+    async def run():
+        st = ClientState(FakeTimerProvider())
+        assert await st.capture_request_seq(1)
+        assert not await st.capture_request_seq(1)  # duplicate while captured
+        await st.release_request_seq(1)
+        assert not await st.capture_request_seq(1)  # duplicate after release
+        assert await st.capture_request_seq(5)  # holes allowed (client clock)
+        await st.release_request_seq(5)
+        assert st.retire_request_seq(5)
+        assert not st.retire_request_seq(5)  # already retired
+
+    asyncio.run(run())
+
+
+def test_clientstate_capture_blocks_until_release():
+    """A second capture for the same client parks until the first is
+    released (reference request-seq.go:47-82 condvar)."""
+
+    async def run():
+        st = ClientState(FakeTimerProvider())
+        assert await st.capture_request_seq(1)
+        order = []
+
+        async def second():
+            order.append("start")
+            got = await st.capture_request_seq(2)
+            order.append(("captured", got))
+
+        task = asyncio.create_task(second())
+        await asyncio.sleep(0.01)
+        assert order == ["start"]  # still parked
+        await st.release_request_seq(1)
+        await asyncio.wait_for(task, 1)
+        assert order == ["start", ("captured", True)]
+
+    asyncio.run(run())
+
+
+def test_clientstate_blocked_duplicate_resolves_false():
+    """A duplicate capture is detectable immediately even while the gate is
+    held by the original — it must not park (reference
+    request-seq.go:61-66)."""
+
+    async def run():
+        st = ClientState(FakeTimerProvider())
+        assert await st.capture_request_seq(3)
+        task = asyncio.create_task(st.capture_request_seq(3))
+        await asyncio.sleep(0.01)
+        assert task.done() and task.result() is False
+
+    asyncio.run(run())
+
+
+def test_clientstate_reply_subscription():
+    async def run():
+        st = ClientState(FakeTimerProvider())
+
+        waiter = asyncio.create_task(st.reply_for(4))
+        await asyncio.sleep(0)
+        st.add_reply(4, "reply-4")
+        assert await asyncio.wait_for(waiter, 1) == "reply-4"
+        # Late subscription sees the buffered reply.
+        assert await st.reply_for(4) == "reply-4"
+
+    asyncio.run(run())
+
+
+def test_clientstates_provider_lazy_map():
+    states = ClientStates(FakeTimerProvider())
+    a = states.client(1)
+    assert states.client(1) is a
+    assert states.client(2) is not a
+
+
+# ---------------------------------------------------------------------------
+# peerstate
+
+
+def test_peerstate_in_order_capture_and_dedup():
+    async def run():
+        st = PeerState()
+        assert await st.capture_ui(1)
+        assert not await st.capture_ui(1)  # replay
+        assert await st.capture_ui(2)
+        assert not await st.capture_ui(1)  # old replay
+
+    asyncio.run(run())
+
+
+def test_peerstate_waits_for_gap():
+    """capture_ui(3) parks until 2 is captured (reference
+    peerstate_test.go:28-105 waiting case)."""
+
+    async def run():
+        st = PeerState()
+        assert await st.capture_ui(1)
+        results = {}
+
+        async def capture(cv):
+            results[cv] = await st.capture_ui(cv)
+
+        ahead = asyncio.create_task(capture(3))
+        await asyncio.sleep(0.01)
+        assert 3 not in results  # parked on the gap
+        assert await st.capture_ui(2)
+        await asyncio.wait_for(ahead, 1)
+        assert results[3] is True
+
+    asyncio.run(run())
+
+
+def test_peerstate_concurrent_out_of_order_capture():
+    """Many concurrent captures in shuffled order all succeed exactly once
+    and complete (the sequencing backbone under concurrency)."""
+
+    async def run():
+        st = PeerState()
+        import random
+
+        cvs = list(range(1, 40))
+        rng = random.Random(7)
+        shuffled = cvs * 2  # every cv twice: one True, one False
+        rng.shuffle(shuffled)
+        results = await asyncio.gather(*[st.capture_ui(cv) for cv in shuffled])
+        assert sum(results) == len(cvs)  # each cv captured exactly once
+
+    asyncio.run(run())
+
+
+def test_peerstate_retreat_allows_retry():
+    async def run():
+        st = PeerState()
+        assert await st.capture_ui(1)
+        await st.retreat_ui(1)
+        assert await st.capture_ui(1)  # retry after failed processing
+
+    asyncio.run(run())
+
+
+def test_peerstates_provider():
+    states = PeerStates()
+    assert states.peer(3) is states.peer(3)
+    assert states.peer(3) is not states.peer(4)
+
+
+# ---------------------------------------------------------------------------
+# messagelog
+
+
+def test_messagelog_replay_then_follow():
+    async def run():
+        log = MessageLog()
+        log.append("a")
+        log.append("b")
+        done = asyncio.Event()
+        got = []
+
+        async def consume():
+            async for m in log.stream(done):
+                got.append(m)
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.01)
+        assert got == ["a", "b"]  # replay
+        log.append("c")
+        await asyncio.sleep(0.01)
+        assert got == ["a", "b", "c"]  # follow
+        done.set()
+        log.append("d")  # wake the stream so it can observe done
+        await asyncio.wait_for(task, 1)
+
+    asyncio.run(run())
+
+
+def test_messagelog_multiple_subscribers_see_everything():
+    """Every subscriber sees every append exactly once, in order, no matter
+    when it subscribed (reference messagelog_test.go:29-117)."""
+
+    async def run():
+        log = MessageLog()
+        done = asyncio.Event()
+        seen = {0: [], 1: [], 2: []}
+
+        async def consume(k, expect):
+            async for m in log.stream(done):
+                seen[k].append(m)
+                if len(seen[k]) == expect:
+                    return
+
+        total = 50
+        early = asyncio.create_task(consume(0, total))
+        await asyncio.sleep(0)
+        for i in range(total // 2):
+            log.append(i)
+        mid = asyncio.create_task(consume(1, total))
+        # Concurrent appender + late subscriber.
+        for i in range(total // 2, total):
+            log.append(i)
+        late = asyncio.create_task(consume(2, total))
+        await asyncio.wait_for(asyncio.gather(early, mid, late), 5)
+        for k in seen:
+            assert seen[k] == list(range(total))
+
+    asyncio.run(run())
+
+
+def test_messagelog_concurrent_appenders():
+    async def run():
+        log = MessageLog()
+        done = asyncio.Event()
+        got = []
+
+        async def consume():
+            async for m in log.stream(done):
+                got.append(m)
+                if len(got) == 100:
+                    return
+
+        async def produce(base):
+            for i in range(50):
+                log.append(base + i)
+                if i % 7 == 0:
+                    await asyncio.sleep(0)
+
+        await asyncio.wait_for(
+            asyncio.gather(consume(), produce(0), produce(1000)), 5
+        )
+        assert sorted(got) == sorted(list(range(50)) + list(range(1000, 1050)))
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# viewstate
+
+
+def test_viewstate_advance_expected_and_current():
+    async def run():
+        vs = ViewState()
+        view, expected = await vs.hold_view()
+        assert (view, expected) == (0, 0)
+        assert await vs.advance_expected_view(1)
+        assert not await vs.advance_expected_view(1)  # dedup
+        assert await vs.advance_expected_view(2)
+        assert await vs.advance_current_view(1)
+        assert not await vs.advance_current_view(1)  # already entered
+        assert not await vs.advance_current_view(5)  # beyond expected
+        assert await vs.advance_current_view(2)
+
+    asyncio.run(run())
