@@ -1,0 +1,283 @@
+/* Native USIG implementation.  See usig.h for the contract and the
+ * reference-parity notes (reference usig/sgx/enclave/usig.c semantics:
+ * sign {digest, epoch, counter}, increment-after-sign, counters from 1,
+ * seal/unseal round-trip).
+ */
+
+#include "usig.h"
+
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <vector>
+
+#include "ossl.h"
+
+namespace {
+
+constexpr unsigned char kSealMagic[4] = {'U', 'S', 'G', '1'};
+
+/* DER ECDSA-Sig-Value -> raw r||s (32+32 big-endian).  The encoding is
+ * SEQUENCE { INTEGER r, INTEGER s } with minimal-length integers. */
+bool der_to_raw64(const unsigned char *der, size_t len, unsigned char out[64]) {
+  size_t off = 0;
+  auto read_hdr = [&](unsigned char want_tag, size_t *out_len) -> bool {
+    if (off + 2 > len || der[off] != want_tag) return false;
+    ++off;
+    size_t l = der[off++];
+    if (l & 0x80) {
+      size_t nbytes = l & 0x7f;
+      if (nbytes == 0 || nbytes > 2 || off + nbytes > len) return false;
+      l = 0;
+      for (size_t i = 0; i < nbytes; ++i) l = (l << 8) | der[off++];
+    }
+    if (off + l > len) return false;
+    *out_len = l;
+    return true;
+  };
+  size_t seq_len;
+  if (!read_hdr(0x30, &seq_len)) return false;
+  std::memset(out, 0, 64);
+  for (int part = 0; part < 2; ++part) {
+    size_t int_len;
+    if (!read_hdr(0x02, &int_len)) return false;
+    const unsigned char *p = der + off;
+    off += int_len;
+    /* strip leading zero pad */
+    while (int_len > 0 && p[0] == 0x00) {
+      ++p;
+      --int_len;
+    }
+    if (int_len > 32) return false;
+    std::memcpy(out + part * 32 + (32 - int_len), p, int_len);
+  }
+  return off == len;
+}
+
+/* raw r||s -> DER (for verification through OpenSSL). */
+std::vector<unsigned char> raw64_to_der(const unsigned char sig[64]) {
+  auto encode_int = [](const unsigned char *p) {
+    std::vector<unsigned char> v;
+    size_t n = 32;
+    while (n > 1 && p[32 - n] == 0x00) --n;
+    const unsigned char *q = p + (32 - n);
+    v.push_back(0x02);
+    if (q[0] & 0x80) {
+      v.push_back(static_cast<unsigned char>(n + 1));
+      v.push_back(0x00);
+    } else {
+      v.push_back(static_cast<unsigned char>(n));
+    }
+    v.insert(v.end(), q, q + n);
+    return v;
+  };
+  std::vector<unsigned char> r = encode_int(sig);
+  std::vector<unsigned char> s = encode_int(sig + 32);
+  std::vector<unsigned char> der;
+  der.push_back(0x30);
+  der.push_back(static_cast<unsigned char>(r.size() + s.size()));
+  der.insert(der.end(), r.begin(), r.end());
+  der.insert(der.end(), s.begin(), s.end());
+  return der;
+}
+
+bool sha256(const void *data, size_t len, unsigned char out[32]) {
+  unsigned int sz = 0;
+  return EVP_Digest(data, len, out, &sz, EVP_sha256(), nullptr) == 1 &&
+         sz == 32;
+}
+
+/* SHA256(digest32 || epoch_be8 || counter_be8) — must match
+ * minbft_tpu/usig/software.py _signed_payload. */
+bool signed_payload(const unsigned char digest[32], uint64_t epoch,
+                    uint64_t counter, unsigned char out[32]) {
+  unsigned char buf[48];
+  std::memcpy(buf, digest, 32);
+  for (int i = 0; i < 8; ++i)
+    buf[32 + i] = static_cast<unsigned char>(epoch >> (56 - 8 * i));
+  for (int i = 0; i < 8; ++i)
+    buf[40 + i] = static_cast<unsigned char>(counter >> (56 - 8 * i));
+  return sha256(buf, sizeof buf, out);
+}
+
+}  // namespace
+
+struct usig {
+  EVP_PKEY *key = nullptr;
+  uint64_t epoch = 0;    /* random per instance (usig.c:181) */
+  uint64_t counter = 1;  /* counters start at 1 */
+  std::mutex mu;         /* reference ecallLock analogue */
+};
+
+extern "C" {
+
+const char *usig_native_version(void) { return "minbft-tpu-usig/1 openssl3"; }
+
+int usig_init(usig_t **out, const uint8_t *sealed, size_t sealed_len) {
+  if (out == nullptr) return USIG_ERR_ARG;
+  usig_t *u = new (std::nothrow) usig_t;
+  if (u == nullptr) return USIG_ERR_ALLOC;
+  if (sealed == nullptr) {
+    u->key = EVP_PKEY_Q_keygen(nullptr, nullptr, "EC", "P-256");
+    if (u->key == nullptr) {
+      delete u;
+      return USIG_ERR_CRYPTO;
+    }
+    unsigned char eb[8];
+    if (RAND_bytes(eb, 8) != 1) {
+      EVP_PKEY_free(u->key);
+      delete u;
+      return USIG_ERR_CRYPTO;
+    }
+    u->epoch = 0;
+    for (int i = 0; i < 8; ++i) u->epoch = (u->epoch << 8) | eb[i];
+  } else {
+    /* seal layout: magic(4) || epoch_be8 || der-private-key */
+    if (sealed_len < 12 || std::memcmp(sealed, kSealMagic, 4) != 0) {
+      delete u;
+      return USIG_ERR_SEALED;
+    }
+    u->epoch = 0;
+    for (int i = 0; i < 8; ++i) u->epoch = (u->epoch << 8) | sealed[4 + i];
+    const unsigned char *p = sealed + 12;
+    u->key = d2i_AutoPrivateKey(nullptr, &p,
+                                static_cast<long>(sealed_len - 12));
+    if (u->key == nullptr) {
+      delete u;
+      return USIG_ERR_SEALED;
+    }
+    /* NOTE: like the reference, only the KEY and epoch are durable; the
+     * counter restarts from 1.  A restored instance must therefore use a
+     * fresh epoch in production deployments — callers get the sealed
+     * epoch back so trust anchors (usig IDs) remain stable, exactly the
+     * reference's unseal behavior (usig.c:140-166 restores the key; the
+     * counter is volatile enclave state). */
+  }
+  *out = u;
+  return USIG_OK;
+}
+
+int usig_destroy(usig_t *u) {
+  if (u == nullptr) return USIG_ERR_ARG;
+  EVP_PKEY_free(u->key);
+  delete u;
+  return USIG_OK;
+}
+
+int usig_get_epoch(usig_t *u, uint64_t *epoch) {
+  if (u == nullptr || epoch == nullptr) return USIG_ERR_ARG;
+  *epoch = u->epoch;
+  return USIG_OK;
+}
+
+int usig_get_pubkey(usig_t *u, uint8_t out[64]) {
+  if (u == nullptr || out == nullptr) return USIG_ERR_ARG;
+  unsigned char pt[65];
+  size_t sz = 0;
+  if (EVP_PKEY_get_octet_string_param(u->key, "pub", pt, sizeof pt, &sz) != 1 ||
+      sz != 65 || pt[0] != 0x04)
+    return USIG_ERR_CRYPTO;
+  std::memcpy(out, pt + 1, 64);
+  return USIG_OK;
+}
+
+int usig_create_ui(usig_t *u, const uint8_t digest[32], uint64_t *counter,
+                   uint8_t sig_out[64]) {
+  if (u == nullptr || digest == nullptr || counter == nullptr ||
+      sig_out == nullptr)
+    return USIG_ERR_ARG;
+  std::lock_guard<std::mutex> lock(u->mu);
+  unsigned char payload[32];
+  if (!signed_payload(digest, u->epoch, u->counter, payload))
+    return USIG_ERR_CRYPTO;
+  EVP_PKEY_CTX *ctx = EVP_PKEY_CTX_new(u->key, nullptr);
+  if (ctx == nullptr) return USIG_ERR_CRYPTO;
+  unsigned char der[80];
+  size_t der_len = sizeof der;
+  int ok = EVP_PKEY_sign_init(ctx) == 1 &&
+           EVP_PKEY_sign(ctx, der, &der_len, payload, 32) == 1;
+  EVP_PKEY_CTX_free(ctx);
+  if (!ok || !der_to_raw64(der, der_len, sig_out)) return USIG_ERR_CRYPTO;
+  *counter = u->counter;
+  /* Increment only after the signature exists: this counter value can
+   * never be issued again (reference usig.c:66-69). */
+  u->counter += 1;
+  return USIG_OK;
+}
+
+int usig_sealed_size(usig_t *u, size_t *out) {
+  if (u == nullptr || out == nullptr) return USIG_ERR_ARG;
+  int der_len = i2d_PrivateKey(u->key, nullptr);
+  if (der_len <= 0) return USIG_ERR_CRYPTO;
+  *out = 12 + static_cast<size_t>(der_len);
+  return USIG_OK;
+}
+
+int usig_seal(usig_t *u, uint8_t *out, size_t cap, size_t *out_len) {
+  if (u == nullptr || out == nullptr || out_len == nullptr)
+    return USIG_ERR_ARG;
+  size_t need = 0;
+  int rc = usig_sealed_size(u, &need);
+  if (rc != USIG_OK) return rc;
+  if (cap < need) return USIG_ERR_BUFSZ;
+  std::memcpy(out, kSealMagic, 4);
+  for (int i = 0; i < 8; ++i)
+    out[4 + i] = static_cast<unsigned char>(u->epoch >> (56 - 8 * i));
+  unsigned char *p = out + 12;
+  int der_len = i2d_PrivateKey(u->key, &p);
+  if (der_len <= 0) return USIG_ERR_CRYPTO;
+  *out_len = 12 + static_cast<size_t>(der_len);
+  return USIG_OK;
+}
+
+int usig_verify_ui(const uint8_t pub[64], uint64_t epoch_be,
+                   const uint8_t digest[32], uint64_t counter,
+                   const uint8_t sig[64]) {
+  if (pub == nullptr || digest == nullptr || sig == nullptr)
+    return USIG_ERR_ARG;
+  unsigned char payload[32];
+  if (!signed_payload(digest, epoch_be, counter, payload))
+    return USIG_ERR_CRYPTO;
+
+  unsigned char pt[65];
+  pt[0] = 0x04;
+  std::memcpy(pt + 1, pub, 64);
+  char group[8] = "P-256";
+  OSSL_PARAM params[3];
+  params[0].key = "group";
+  params[0].data_type = OSSL_PARAM_UTF8_STRING;
+  params[0].data = group;
+  params[0].data_size = 5;
+  params[0].return_size = static_cast<size_t>(-1);
+  params[1].key = "pub";
+  params[1].data_type = OSSL_PARAM_OCTET_STRING;
+  params[1].data = pt;
+  params[1].data_size = sizeof pt;
+  params[1].return_size = static_cast<size_t>(-1);
+  params[2].key = nullptr;
+  params[2].data_type = 0;
+  params[2].data = nullptr;
+  params[2].data_size = 0;
+  params[2].return_size = 0;
+
+  EVP_PKEY_CTX *fctx = EVP_PKEY_CTX_new_from_name(nullptr, "EC", nullptr);
+  if (fctx == nullptr) return USIG_ERR_CRYPTO;
+  EVP_PKEY *pkey = nullptr;
+  int ok = EVP_PKEY_fromdata_init(fctx) == 1 &&
+           EVP_PKEY_fromdata(fctx, &pkey, EVP_PKEY_PUBLIC_KEY, params) == 1;
+  EVP_PKEY_CTX_free(fctx);
+  if (!ok || pkey == nullptr) return USIG_ERR_CRYPTO;
+
+  std::vector<unsigned char> der = raw64_to_der(sig);
+  EVP_PKEY_CTX *vctx = EVP_PKEY_CTX_new(pkey, nullptr);
+  int valid = 0;
+  if (vctx != nullptr) {
+    valid = EVP_PKEY_verify_init(vctx) == 1 &&
+            EVP_PKEY_verify(vctx, der.data(), der.size(), payload, 32) == 1;
+    EVP_PKEY_CTX_free(vctx);
+  }
+  EVP_PKEY_free(pkey);
+  return valid ? USIG_OK : USIG_ERR_CRYPTO;
+}
+
+}  /* extern "C" */

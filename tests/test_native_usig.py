@@ -1,0 +1,108 @@
+"""Native C++ USIG module tests.
+
+Builds the module in-tree (skips if the toolchain can't), runs the C++
+test binary (the port of reference usig/sgx/test/usig_test.c:34-60), and
+cross-checks the Python binding against the software USIG and the TPU
+batch-verification path: a natively-created UI must verify everywhere.
+"""
+
+import hashlib
+import os
+import subprocess
+
+import pytest
+
+from minbft_tpu.usig import UsigError
+from minbft_tpu.usig import native as native_mod
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "minbft_tpu", "native"
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_mod.build(), reason="native toolchain unavailable"
+)
+
+
+def test_cxx_test_binary_passes():
+    res = subprocess.run(
+        ["make", "check"], cwd=os.path.abspath(NATIVE_DIR),
+        capture_output=True, text=True, timeout=180,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "all checks passed" in res.stdout
+
+
+def test_counter_monotonic_and_cert_format():
+    u = native_mod.NativeEcdsaUSIG()
+    uis = [u.create_ui(b"msg-%d" % i) for i in range(4)]
+    assert [ui.counter for ui in uis] == [1, 2, 3, 4]
+    for ui in uis:
+        assert len(ui.cert) == 8 + 64  # epoch || r || s
+    # verify via the native verifier
+    for i, ui in enumerate(uis):
+        u.verify_ui(b"msg-%d" % i, ui, u.id())
+    with pytest.raises(UsigError):
+        u.verify_ui(b"other", uis[0], u.id())
+
+
+def test_native_ui_verifies_via_python_software_path():
+    """The native cert format is byte-compatible with EcdsaUSIG: the pure
+    Python verifier accepts natively-signed UIs."""
+    from minbft_tpu.usig.software import EcdsaUSIG
+
+    u = native_mod.NativeEcdsaUSIG()
+    ui = u.create_ui(b"cross-check")
+    # Any EcdsaUSIG instance can verify a foreign UI given the usig_id.
+    verifier = EcdsaUSIG()
+    verifier.verify_ui(b"cross-check", ui, u.id())
+    with pytest.raises(UsigError):
+        verifier.verify_ui(b"cross-check!", ui, u.id())
+
+
+def test_native_ui_verifies_on_tpu_batch_path():
+    """usig_verify_items decomposes a native UI into the (pubkey, digest,
+    sig) triple and the batch kernel accepts it (SIM backend)."""
+    import numpy as np
+
+    from minbft_tpu.ops import lowering, p256
+    from minbft_tpu.usig.software import usig_verify_items
+
+    u = native_mod.NativeEcdsaUSIG()
+    good = u.create_ui(b"batch-me")
+    q, payload, sig = usig_verify_items(b"batch-me", good, u.id())
+
+    bad_sig = (sig[0], sig[1] ^ 2)
+    lowering.set_mode("loop")
+    try:
+        out = p256.verify_batch([(q, payload, sig), (q, payload, bad_sig)])
+    finally:
+        lowering.set_mode(None)
+    assert out.tolist() == [True, False]
+
+
+def test_seal_restores_key_and_epoch():
+    u = native_mod.NativeEcdsaUSIG()
+    blob = u.seal()
+    ui1 = u.create_ui(b"before")
+
+    r = native_mod.NativeEcdsaUSIG.from_sealed(blob)
+    assert r.id() == u.id()  # same epoch + pubkey: trust anchors stable
+    ui2 = r.create_ui(b"after")
+    assert ui2.counter == 1  # counter is volatile state
+    r.verify_ui(b"after", ui2, u.id())
+    u.verify_ui(b"before", ui1, r.id())
+
+    with pytest.raises(UsigError):
+        native_mod.NativeEcdsaUSIG.from_sealed(b"\x00" * 20)
+
+
+def test_python_fallback_when_library_missing(tmp_path, monkeypatch):
+    """load() returns None for a missing library path and the authenticator
+    stack still works through the software USIG (clean fallback)."""
+    monkeypatch.setattr(native_mod, "_LIB_PATH", str(tmp_path / "nope.so"))
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_load_attempted", False)
+    assert native_mod.load(auto_build=False) is None
+    with pytest.raises(UsigError):
+        native_mod.NativeEcdsaUSIG(_lib_override=None)
