@@ -9,5 +9,12 @@ every concurrent protocol validation joins a batched XLA kernel launch
 ("TPUAuthenticator" in BASELINE.json)."""
 
 from .authenticator import SampleAuthenticator, new_test_authenticators
+from .keystore import KeyStore, KeyStoreError, generate_testnet_keys
 
-__all__ = ["SampleAuthenticator", "new_test_authenticators"]
+__all__ = [
+    "SampleAuthenticator",
+    "new_test_authenticators",
+    "KeyStore",
+    "KeyStoreError",
+    "generate_testnet_keys",
+]

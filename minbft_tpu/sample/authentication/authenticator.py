@@ -170,7 +170,8 @@ class SampleAuthenticator(api.Authenticator):
             raise api.AuthenticationError(f"malformed UI: {e}") from e
         if ui.counter == 0:
             raise api.AuthenticationError("zero UI counter")
-        if self._engine is not None and isinstance(self._usig, EcdsaUSIG):
+        usig_scheme = getattr(self._usig, "SCHEME", None)
+        if self._engine is not None and usig_scheme == "ecdsa-p256":
             # Batched TPU verification of the UI certificate (the TPU-USIG
             # of BASELINE.json).
             from ...usig.software import UsigError, usig_verify_items
@@ -182,9 +183,19 @@ class SampleAuthenticator(api.Authenticator):
             if not await self._engine.verify_ecdsa_p256(q, payload, sig):
                 raise api.AuthenticationError("invalid UI certificate")
             return
-        if self._engine is not None and isinstance(self._usig, HmacUSIG):
-            epoch, _fp = parse_usig_id(usig_id)
-            if len(ui.cert) < _EPOCH_LEN + 32 or ui.cert[:_EPOCH_LEN] != epoch:
+        if self._engine is not None and usig_scheme == "hmac-sha256":
+            from ...usig.software import UsigError
+
+            try:
+                epoch, fp = parse_usig_id(usig_id)
+            except UsigError as e:
+                raise api.AuthenticationError(str(e)) from e
+            # Mirror the serial HmacUSIG._verify checks exactly so batch and
+            # serial verification can never disagree: key-fingerprint match
+            # and an exact-length cert (no trailing bytes after the MAC).
+            if fp != hashlib.sha256(self._usig._key).digest():
+                raise api.AuthenticationError("USIG key fingerprint mismatch")
+            if len(ui.cert) != _EPOCH_LEN + 32 or ui.cert[:_EPOCH_LEN] != epoch:
                 raise api.AuthenticationError("epoch mismatch")
             digest = hashlib.sha256(msg).digest()
             payload = _signed_payload(digest, epoch, ui.counter)

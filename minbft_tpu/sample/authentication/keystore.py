@@ -1,0 +1,343 @@
+"""Persistent keystore: the keys.yaml of this build.
+
+The reference stores all testnet key material in one YAML file with three
+sections — replica / usig / client, each ``{keyspec, keys: [{id, ...}]}``
+(reference sample/authentication/keymanager.go:129-162) — and pluggable
+keyspecs (``ECDSA``, ``SGX_ECDSA``; keymanager.go:169-328).  This build
+keeps that shape with its own specs:
+
+- ``ECDSA_P256`` / ``ED25519`` — signature keypairs for the replica and
+  client sections (privateKey/publicKey, base64).
+- ``NATIVE_ECDSA`` — USIG sealed by the native C++ module
+  (minbft_tpu/native); the sealed blob is opaque to Python, exactly as the
+  enclave-sealed key is opaque to the reference's Go side
+  (keymanager.go:299-328 stores it base64).
+- ``SOFT_ECDSA`` — software-sealed USIG (SIM mode): a self-describing blob
+  holding epoch + private scalar with an integrity checksum.  Like SGX SIM
+  sealing, this provides durability, not confidentiality.
+- ``HMAC_SHA256`` — the shared-key testnet USIG; the blob holds the
+  per-replica epoch + the cluster-shared MAC key.
+
+Every usig entry also records the **public** ``usigId`` (epoch || key
+material) — the trust anchors distributed to all peers (the reference
+derives them on load from the enclave/pubkeys; storing them keeps load
+cheap and lets a keystore be distributed with private fields stripped).
+
+Durable-state story (SURVEY.md §5 "checkpoint/resume"): the sealed USIG
+key is the system's only durable state.  ``KeyStore.make_usig`` restores a
+replica's USIG from its sealed blob, so a restarted replica keeps its key
+and epoch — peers' trust anchors remain valid — while the counter restarts
+at 1 (volatile, reference usig/sgx/usig-enclave.go:254-268 semantics).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import secrets
+from typing import Dict, Optional, Tuple
+
+from ...usig.software import EcdsaUSIG, HmacUSIG
+from ...utils import hostcrypto as hc
+from .authenticator import SampleAuthenticator
+
+_EPOCH_LEN = 8
+_SOFT_MAGIC = b"SSL1"
+
+
+# --------------------------------------------------------------------------
+# signature keyspecs
+
+
+def _ecdsa_generate() -> Tuple[bytes, bytes]:
+    d, (x, y) = hc.keygen()
+    return d.to_bytes(32, "big"), x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def _ecdsa_decode(priv: Optional[bytes], pub: bytes):
+    q = (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big"))
+    return (int.from_bytes(priv, "big") if priv else None), q
+
+
+def _ed25519_generate() -> Tuple[bytes, bytes]:
+    seed, pub = hc.ed25519_keygen()
+    return seed, pub
+
+
+def _ed25519_decode(priv: Optional[bytes], pub: bytes):
+    return priv, pub
+
+
+_SIG_SPECS = {
+    "ECDSA_P256": ("ecdsa-p256", _ecdsa_generate, _ecdsa_decode),
+    "ED25519": ("ed25519", _ed25519_generate, _ed25519_decode),
+}
+_SPEC_FOR_SCHEME = {v[0]: k for k, v in _SIG_SPECS.items()}
+
+
+# --------------------------------------------------------------------------
+# USIG keyspecs (sealed blobs)
+
+
+def _soft_seal(epoch: bytes, d: int) -> bytes:
+    body = _SOFT_MAGIC + epoch + d.to_bytes(32, "big")
+    return body + hashlib.sha256(body).digest()[:8]
+
+
+def _soft_unseal(blob: bytes) -> Tuple[bytes, int]:
+    if len(blob) != 4 + _EPOCH_LEN + 32 + 8 or blob[:4] != _SOFT_MAGIC:
+        raise ValueError("malformed soft-sealed USIG blob")
+    body, check = blob[:-8], blob[-8:]
+    if hashlib.sha256(body).digest()[:8] != check:
+        raise ValueError("soft-sealed USIG blob failed integrity check")
+    return blob[4 : 4 + _EPOCH_LEN], int.from_bytes(blob[4 + _EPOCH_LEN : -8], "big")
+
+
+def _new_usig(spec: str, shared_hmac_key: Optional[bytes] = None):
+    """Create a fresh USIG for ``spec``; returns (usig, sealed_blob)."""
+    if spec == "NATIVE_ECDSA":
+        from ...usig.native import NativeEcdsaUSIG
+
+        u = NativeEcdsaUSIG()
+        return u, u.seal()
+    if spec == "SOFT_ECDSA":
+        u = EcdsaUSIG()
+        return u, _soft_seal(u.epoch, u._d)
+    if spec == "HMAC_SHA256":
+        key = shared_hmac_key or secrets.token_bytes(32)
+        u = HmacUSIG(key)
+        return u, u.epoch + key
+    raise ValueError(f"unknown USIG keyspec {spec!r}")
+
+
+def _restore_usig(spec: str, sealed: bytes):
+    if spec == "NATIVE_ECDSA":
+        from ...usig.native import NativeEcdsaUSIG
+
+        return NativeEcdsaUSIG.from_sealed(sealed)
+    if spec == "SOFT_ECDSA":
+        epoch, d = _soft_unseal(sealed)
+        return EcdsaUSIG(private_key=d, epoch=epoch)
+    if spec == "HMAC_SHA256":
+        if len(sealed) < _EPOCH_LEN + 32:
+            raise ValueError("malformed HMAC USIG blob")
+        return HmacUSIG(sealed[_EPOCH_LEN : _EPOCH_LEN + 32], epoch=sealed[:_EPOCH_LEN])
+    raise ValueError(f"unknown USIG keyspec {spec!r}")
+
+
+# --------------------------------------------------------------------------
+
+
+class KeyStoreError(Exception):
+    pass
+
+
+class KeyStore:
+    """In-memory form of a keys.yaml (reference BftKeyStorer,
+    keymanager.go:39-47): per-section keyspec + id-indexed key material."""
+
+    def __init__(
+        self,
+        scheme: str = "ecdsa-p256",
+        usig_spec: str = "SOFT_ECDSA",
+    ):
+        if scheme not in _SPEC_FOR_SCHEME:
+            raise KeyStoreError(f"unknown signature scheme {scheme!r}")
+        self.scheme = scheme
+        self.usig_spec = usig_spec
+        # {id: (privateKey bytes|None, publicKey bytes)}
+        self.replica_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
+        self.client_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
+        # {id: (sealed bytes|None, usig_id bytes)}
+        self.usig_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
+
+    # -- serialization -------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        def sig_section(keys):
+            return {
+                "keyspec": _SPEC_FOR_SCHEME[self.scheme],
+                "keys": [
+                    {
+                        "id": kid,
+                        **(
+                            {"privateKey": base64.b64encode(priv).decode()}
+                            if priv is not None
+                            else {}
+                        ),
+                        "publicKey": base64.b64encode(pub).decode(),
+                    }
+                    for kid, (priv, pub) in sorted(keys.items())
+                ],
+            }
+
+        return {
+            "replica": sig_section(self.replica_keys),
+            "client": sig_section(self.client_keys),
+            "usig": {
+                "keyspec": self.usig_spec,
+                "keys": [
+                    {
+                        "id": kid,
+                        **(
+                            {"sealedKey": base64.b64encode(sealed).decode()}
+                            if sealed is not None
+                            else {}
+                        ),
+                        "usigId": base64.b64encode(uid).decode(),
+                    }
+                    for kid, (sealed, uid) in sorted(self.usig_keys.items())
+                ],
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "KeyStore":
+        rep = data.get("replica", {})
+        spec = rep.get("keyspec", "ECDSA_P256")
+        if spec not in _SIG_SPECS:
+            raise KeyStoreError(f"unknown signature keyspec {spec!r}")
+        client_spec = data.get("client", {}).get("keyspec", spec)
+        if client_spec != spec:
+            # One signature scheme per store (the decode path is shared);
+            # refuse rather than silently misdecode client keys.
+            raise KeyStoreError(
+                f"client keyspec {client_spec!r} != replica keyspec {spec!r}"
+            )
+        usig = data.get("usig", {})
+        store = cls(scheme=_SIG_SPECS[spec][0], usig_spec=usig.get("keyspec", "SOFT_ECDSA"))
+
+        def read_sig(section) -> Dict[int, Tuple[Optional[bytes], bytes]]:
+            out = {}
+            for entry in section.get("keys", []):
+                priv = entry.get("privateKey")
+                out[int(entry["id"])] = (
+                    base64.b64decode(priv) if priv else None,
+                    base64.b64decode(entry["publicKey"]),
+                )
+            return out
+
+        store.replica_keys = read_sig(rep)
+        store.client_keys = read_sig(data.get("client", {}))
+        for entry in usig.get("keys", []):
+            sealed = entry.get("sealedKey")
+            store.usig_keys[int(entry["id"])] = (
+                base64.b64decode(sealed) if sealed else None,
+                base64.b64decode(entry["usigId"]),
+            )
+        return store
+
+    def save(self, path: str) -> None:
+        import yaml
+
+        with open(path, "w") as fh:
+            yaml.safe_dump(self.to_dict(), fh, sort_keys=False)
+
+    @classmethod
+    def load(cls, path: str) -> "KeyStore":
+        import yaml
+
+        with open(path) as fh:
+            data = yaml.safe_load(fh) or {}
+        return cls.from_dict(data)
+
+    def strip_private(self, keep_replica: Optional[int] = None) -> "KeyStore":
+        """A copy safe to hand to other nodes: private material removed
+        except (optionally) one replica's own keys."""
+        out = KeyStore(scheme=self.scheme, usig_spec=self.usig_spec)
+        out.replica_keys = {
+            kid: (priv if kid == keep_replica else None, pub)
+            for kid, (priv, pub) in self.replica_keys.items()
+        }
+        out.client_keys = {kid: (None, pub) for kid, (_, pub) in self.client_keys.items()}
+        out.usig_keys = {
+            kid: (sealed if kid == keep_replica else None, uid)
+            for kid, (sealed, uid) in self.usig_keys.items()
+        }
+        return out
+
+    # -- restoration ---------------------------------------------------------
+
+    def make_usig(self, replica_id: int):
+        """Restore replica_id's USIG from its sealed blob (durable state)."""
+        sealed, expect_id = self.usig_keys[replica_id]
+        if sealed is None:
+            raise KeyStoreError(f"no sealed USIG key for replica {replica_id}")
+        u = _restore_usig(self.usig_spec, sealed)
+        if u.id() != expect_id:
+            raise KeyStoreError(
+                f"restored USIG id mismatch for replica {replica_id}"
+            )
+        return u
+
+    def usig_ids(self) -> Dict[int, bytes]:
+        return {kid: uid for kid, (_, uid) in self.usig_keys.items()}
+
+    def _decode_sig(self, keys, kid: int):
+        if kid not in keys:
+            raise KeyStoreError(f"no key with id {kid}")
+        priv, pub = keys[kid]
+        return _SIG_SPECS[_SPEC_FOR_SCHEME[self.scheme]][2](priv, pub)
+
+    def replica_pubs(self) -> Dict[int, object]:
+        return {kid: self._decode_sig(self.replica_keys, kid)[1] for kid in self.replica_keys}
+
+    def client_pubs(self) -> Dict[int, object]:
+        return {kid: self._decode_sig(self.client_keys, kid)[1] for kid in self.client_keys}
+
+    def replica_authenticator(
+        self, replica_id: int, engine=None, batch_signatures: bool = True
+    ) -> SampleAuthenticator:
+        priv, _ = self._decode_sig(self.replica_keys, replica_id)
+        if priv is None:
+            raise KeyStoreError(f"no private key for replica {replica_id}")
+        return SampleAuthenticator(
+            scheme=self.scheme,
+            replica_priv=priv,
+            replica_pubs=self.replica_pubs(),
+            client_pubs=self.client_pubs(),
+            usig=self.make_usig(replica_id),
+            usig_ids=self.usig_ids(),
+            engine=engine,
+            batch_signatures=batch_signatures,
+        )
+
+    def client_authenticator(self, client_id: int, engine=None) -> SampleAuthenticator:
+        priv, _ = self._decode_sig(self.client_keys, client_id)
+        if priv is None:
+            raise KeyStoreError(f"no private key for client {client_id}")
+        return SampleAuthenticator(
+            scheme=self.scheme,
+            client_priv=priv,
+            replica_pubs=self.replica_pubs(),
+            client_pubs=self.client_pubs(),
+            engine=engine,
+        )
+
+
+def generate_testnet_keys(
+    n: int,
+    n_clients: int = 1,
+    scheme: str = "ecdsa-p256",
+    usig_spec: str = "auto",
+) -> KeyStore:
+    """Generate a full testnet keystore (reference GenerateTestnetKeys,
+    keymanager.go:404-450): n replica keypairs + USIGs, n_clients client
+    keypairs.  ``usig_spec="auto"`` prefers the native module and falls
+    back to the software seal."""
+    if usig_spec == "auto":
+        from ...usig import native as native_mod
+
+        usig_spec = "NATIVE_ECDSA" if native_mod.available(auto_build=True) else "SOFT_ECDSA"
+    store = KeyStore(scheme=scheme, usig_spec=usig_spec)
+    spec = _SPEC_FOR_SCHEME[scheme]
+    gen = _SIG_SPECS[spec][1]
+    for i in range(n):
+        store.replica_keys[i] = gen()
+    for c in range(n_clients):
+        store.client_keys[c] = gen()
+    shared = secrets.token_bytes(32) if usig_spec == "HMAC_SHA256" else None
+    for i in range(n):
+        u, sealed = _new_usig(usig_spec, shared_hmac_key=shared)
+        store.usig_keys[i] = (sealed, u.id())
+    return store

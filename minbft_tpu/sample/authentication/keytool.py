@@ -1,0 +1,104 @@
+"""keytool — generate testnet keystores from the command line.
+
+Reference: the cobra/viper ``keytool generate`` command
+(sample/authentication/keytool/cmd/generate.go:44-142) writes a keys.yaml
+with replica/usig/client sections.  Usage:
+
+    python -m minbft_tpu.sample.authentication.keytool generate \
+        -o keys.yaml -n 3 --clients 1 --scheme ecdsa-p256 --usig auto
+
+Flags fall back to ``KEYTOOL_*`` environment variables (the viper env
+binding equivalent, reference keytool/cmd/root.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _env_default(name: str, fallback):
+    v = os.environ.get(f"KEYTOOL_{name.upper()}")
+    if v is None:
+        return fallback
+    try:
+        return type(fallback)(v)
+    except ValueError:
+        raise SystemExit(
+            f"keytool: invalid KEYTOOL_{name.upper()}={v!r} "
+            f"(expected {type(fallback).__name__})"
+        )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="keytool", description="minbft-tpu keystore generation"
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    g = sub.add_parser("generate", help="generate a testnet keys.yaml")
+    g.add_argument(
+        "-o",
+        "--output",
+        default=_env_default("output", "keys.yaml"),
+        help="output path (default keys.yaml)",
+    )
+    g.add_argument(
+        "-n",
+        "--replicas",
+        type=int,
+        default=_env_default("replicas", 3),
+        help="number of replicas",
+    )
+    g.add_argument(
+        "--clients",
+        type=int,
+        default=_env_default("clients", 1),
+        help="number of clients",
+    )
+    g.add_argument(
+        "--scheme",
+        choices=("ecdsa-p256", "ed25519"),
+        default=_env_default("scheme", "ecdsa-p256"),
+        help="signature scheme for replica/client keys",
+    )
+    g.add_argument(
+        "--usig",
+        choices=("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"),
+        default=_env_default("usig", "auto"),
+        help="USIG keyspec (auto = native module if buildable, else soft)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "generate":
+        # argparse does not run `choices` validation on defaults, so
+        # env-provided values need an explicit check.
+        if args.scheme not in ("ecdsa-p256", "ed25519"):
+            parser.error(f"invalid scheme {args.scheme!r}")
+        if args.usig not in ("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"):
+            parser.error(f"invalid usig keyspec {args.usig!r}")
+        from .keystore import generate_testnet_keys
+
+        store = generate_testnet_keys(
+            n=args.replicas,
+            n_clients=args.clients,
+            scheme=args.scheme,
+            usig_spec=args.usig,
+        )
+        store.save(args.output)
+        print(
+            f"wrote {args.output}: {args.replicas} replicas, "
+            f"{args.clients} clients, scheme={store.scheme}, "
+            f"usig={store.usig_spec}",
+            file=sys.stderr,
+        )
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -181,8 +181,11 @@ def usig_verify_items(
     """
     if ui.counter == 0:
         raise UsigError("zero counter")
-    if len(ui.cert) < _EPOCH_LEN + 64:
-        raise UsigError("certificate too short")
+    if len(ui.cert) != _EPOCH_LEN + 64:
+        # Exact length: padding or trailing bytes would otherwise verify on
+        # the batch path but be rejected by the serial verifier
+        # (certificate-encoding malleability).
+        raise UsigError("malformed certificate")
     cert_epoch, sig = ui.cert[:_EPOCH_LEN], ui.cert[_EPOCH_LEN:]
     id_epoch, key_material = parse_usig_id(usig_id)
     if cert_epoch != id_epoch or len(key_material) != 64:

@@ -1,0 +1,80 @@
+"""Batch/serial verification parity for the USIG schemes.
+
+The TPU batch path must accept exactly the certificates the serial
+verifier accepts (reference behavior: one verifier, usig/sgx/sgx-usig.go:81-97).
+These are regression tests for two divergences found in review:
+
+- an over-long ECDSA cert (epoch || r || s || padding) must be rejected by
+  ``usig_verify_items`` just as the serial verifier rejects it;
+- the HMAC batch path must enforce the usig_id key-fingerprint check and
+  the exact cert length that ``HmacUSIG._verify`` enforces.
+"""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.messages import UI
+from minbft_tpu.parallel import BatchVerifier
+from minbft_tpu.sample.authentication import SampleAuthenticator
+from minbft_tpu.usig.software import (
+    EcdsaUSIG,
+    HmacUSIG,
+    UsigError,
+    usig_verify_items,
+)
+
+
+def test_overlong_ecdsa_cert_rejected():
+    u = EcdsaUSIG()
+    ui = u.create_ui(b"msg")
+    padded = UI(counter=ui.counter, cert=ui.cert + b"\x00")
+    with pytest.raises(UsigError):
+        usig_verify_items(b"msg", padded, u.id())
+    short = UI(counter=ui.counter, cert=ui.cert[:-1])
+    with pytest.raises(UsigError):
+        usig_verify_items(b"msg", short, u.id())
+    # the canonical cert still decomposes fine
+    usig_verify_items(b"msg", ui, u.id())
+
+
+def _hmac_authenticator(key: bytes, engine) -> SampleAuthenticator:
+    usig = HmacUSIG(key)
+    return SampleAuthenticator(usig=usig, usig_ids={0: usig.id()}, engine=engine), usig
+
+
+def test_hmac_batch_matches_serial():
+    async def run():
+        engine = BatchVerifier(max_batch=8, buckets=(8,))
+        key = hashlib.sha256(b"k").digest()
+        auth, usig = _hmac_authenticator(key, engine)
+        ui = usig.create_ui(b"msg")
+
+        # canonical tag verifies
+        await auth.verify_message_authen_tag(
+            api.AuthenticationRole.USIG, 0, b"msg", ui.to_bytes()
+        )
+
+        # trailing bytes after the MAC: serial rejects, batch must too
+        padded = UI(counter=ui.counter, cert=ui.cert + b"\x00")
+        with pytest.raises(UsigError):
+            usig.verify_ui(b"msg", padded, usig.id())
+        with pytest.raises(api.AuthenticationError):
+            await auth.verify_message_authen_tag(
+                api.AuthenticationRole.USIG, 0, b"msg", padded.to_bytes()
+            )
+
+        # a usig_id claiming a different key fingerprint must fail in batch
+        # mode exactly as it does serially
+        other = HmacUSIG(hashlib.sha256(b"other").digest(), epoch=usig.epoch)
+        auth2 = SampleAuthenticator(
+            usig=usig, usig_ids={0: other.id()}, engine=engine
+        )
+        with pytest.raises(api.AuthenticationError):
+            await auth2.verify_message_authen_tag(
+                api.AuthenticationRole.USIG, 0, b"msg", ui.to_bytes()
+            )
+
+    asyncio.run(run())
