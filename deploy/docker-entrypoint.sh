@@ -1,0 +1,29 @@
+#!/bin/sh
+# Entrypoint: scaffold shared testnet files once (under a lock), then exec
+# the peer CLI (reference sample/docker/docker-entrypoint.sh pattern).
+set -e
+cd /data
+
+if [ ! -f consensus.yaml ]; then
+    if mkdir .scaffold.lock 2>/dev/null; then
+        # Drop the lock even if scaffolding dies mid-way, so a restarted
+        # compose run can take over instead of waiting forever.
+        trap 'rmdir .scaffold.lock 2>/dev/null || true' EXIT INT TERM
+        # compose service names resolve as hostnames; rewrite peers[] to them
+        python -m minbft_tpu.sample.peer testnet -n 3 -d . --base-port 42610 \
+            --host 127.0.0.1
+        python - <<'EOF'
+import yaml
+cfg = yaml.safe_load(open("consensus.yaml"))
+for p in cfg["peers"]:
+    p["addr"] = "replica%d:%d" % (p["id"], 42610 + p["id"])
+yaml.safe_dump(cfg, open("consensus.yaml", "w"), sort_keys=False)
+EOF
+        rmdir .scaffold.lock 2>/dev/null || true
+        trap - EXIT INT TERM
+    else
+        while [ -d .scaffold.lock ] || [ ! -f consensus.yaml ]; do sleep 0.5; done
+    fi
+fi
+
+exec python -m minbft_tpu.sample.peer "$@"

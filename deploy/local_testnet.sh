@@ -1,0 +1,29 @@
+#!/bin/bash
+# Run an n-replica testnet as local processes and commit a request through
+# it — the no-Docker deployment check (reference README.md:411-458 runs the
+# same flow by hand).  Usage: deploy/local_testnet.sh [n] [dir]
+set -euo pipefail
+N="${1:-3}"
+DIR="${2:-$(mktemp -d /tmp/minbft-testnet.XXXXXX)}"
+PORT=43700
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+python -m minbft_tpu.sample.peer testnet -n "$N" -d "$DIR" --base-port "$PORT"
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+for i in $(seq 0 $((N - 1))); do
+    python -m minbft_tpu.sample.peer \
+        --keys "$DIR/keys.yaml" --config "$DIR/consensus.yaml" \
+        run "$i" --no-batch >"$DIR/replica$i.log" 2>&1 &
+    pids+=($!)
+done
+
+sleep 8
+python -m minbft_tpu.sample.peer \
+    --keys "$DIR/keys.yaml" --config "$DIR/consensus.yaml" \
+    request "local-testnet-$(date +%s)"
+echo "testnet OK (logs in $DIR)"

@@ -109,8 +109,21 @@ def new_replica(
     consumer: api.RequestConsumer,
     timer_provider: Optional[TimerProvider] = None,
     logger: Optional[logging.Logger] = None,
+    opts=None,
 ) -> api.Replica:
-    """Create a replica (reference minbft.New, core/replica.go:50)."""
+    """Create a replica (reference minbft.New, core/replica.go:50).
+
+    ``opts`` takes functional options from :mod:`minbft_tpu.core.options`
+    (reference core/options.go); the explicit ``timer_provider``/``logger``
+    keywords remain as shortcuts and win over options."""
+    if opts:
+        from . import options as options_mod
+
+        resolved = options_mod.resolve(
+            replica_id, opts, materialize_logger=logger is None
+        )
+        timer_provider = timer_provider or resolved.timer_provider
+        logger = logger or resolved.logger
     return _Replica(
         replica_id, configer, authenticator, connector, consumer, timer_provider, logger
     )

@@ -143,6 +143,8 @@ class KeyStore:
     ):
         if scheme not in _SPEC_FOR_SCHEME:
             raise KeyStoreError(f"unknown signature scheme {scheme!r}")
+        if usig_spec not in ("NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"):
+            raise KeyStoreError(f"unknown USIG keyspec {usig_spec!r}")
         self.scheme = scheme
         self.usig_spec = usig_spec
         # {id: (privateKey bytes|None, publicKey bytes)}

@@ -14,21 +14,17 @@ binding equivalent, reference keytool/cmd/root.go).
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 
-def _env_default(name: str, fallback):
-    v = os.environ.get(f"KEYTOOL_{name.upper()}")
-    if v is None:
-        return fallback
-    try:
-        return type(fallback)(v)
-    except ValueError:
-        raise SystemExit(
-            f"keytool: invalid KEYTOOL_{name.upper()}={v!r} "
-            f"(expected {type(fallback).__name__})"
-        )
+from ..envflags import env_default
+
+_SCHEMES = ("ecdsa-p256", "ed25519")
+_USIG_SPECS = ("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256")
+
+
+def _env_default(name: str, fallback, choices=None):
+    return env_default("KEYTOOL", name, fallback, choices)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -58,14 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     g.add_argument(
         "--scheme",
-        choices=("ecdsa-p256", "ed25519"),
-        default=_env_default("scheme", "ecdsa-p256"),
+        choices=_SCHEMES,
+        default=_env_default("scheme", "ecdsa-p256", choices=_SCHEMES),
         help="signature scheme for replica/client keys",
     )
     g.add_argument(
         "--usig",
-        choices=("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"),
-        default=_env_default("usig", "auto"),
+        choices=_USIG_SPECS,
+        default=_env_default("usig", "auto", choices=_USIG_SPECS),
         help="USIG keyspec (auto = native module if buildable, else soft)",
     )
     return p
@@ -75,12 +71,6 @@ def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.command == "generate":
-        # argparse does not run `choices` validation on defaults, so
-        # env-provided values need an explicit check.
-        if args.scheme not in ("ecdsa-p256", "ed25519"):
-            parser.error(f"invalid scheme {args.scheme!r}")
-        if args.usig not in ("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"):
-            parser.error(f"invalid usig keyspec {args.usig!r}")
         from .keystore import generate_testnet_keys
 
         store = generate_testnet_keys(

@@ -1,0 +1,5 @@
+"""peer CLI package (reference sample/peer/)."""
+
+from .cli import main
+
+__all__ = ["main"]

@@ -1,0 +1,311 @@
+"""peer — run a replica or submit requests from the command line.
+
+Reference sample/peer: ``peer run <id>`` loads the keystore + consensus
+config, assembles the stack (authenticator, ledger, gRPC connector), and
+serves (run.go:91-159); ``peer request <args…>`` is the client-side
+equivalent, reading operations from argv or stdin (request.go:87-134);
+flags layer over ``PEER_*`` environment variables (root.go:73-82).
+
+    python -m minbft_tpu.sample.peer run 0 --keys keys.yaml --config consensus.yaml
+    python -m minbft_tpu.sample.peer request --keys keys.yaml --config consensus.yaml "op"
+    python -m minbft_tpu.sample.peer selftest   # in-process n=4 smoke test
+
+The replica's COMMIT-phase verification runs through the TPU batching
+engine (``--batch``); ``--no-batch`` falls back to serial host crypto.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import sys
+
+
+from ..envflags import env_default
+
+
+def _env(name: str, fallback, choices=None):
+    return env_default("PEER", name, fallback, choices)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="peer", description="minbft-tpu peer")
+    p.add_argument(
+        "--keys", default=_env("keys", "keys.yaml"), help="keystore path"
+    )
+    p.add_argument(
+        "--config",
+        default=_env("config", "consensus.yaml"),
+        help="consensus config path",
+    )
+    _levels = ("debug", "info", "warning", "error")
+    p.add_argument(
+        "--log-level",
+        default=_env("log_level", "info", choices=_levels),
+        choices=_levels,
+    )
+    p.add_argument("--log-file", default=_env("log_file", "") or None)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    r = sub.add_parser("run", help="run a replica")
+    r.add_argument("id", type=int, help="replica id")
+    r.add_argument(
+        "--listen",
+        default=_env("listen", ""),
+        help="listen address (default: this id's addr from the config)",
+    )
+    r.add_argument(
+        "--batch",
+        type=int,
+        default=_env("batch", 512),
+        help="max verification batch per kernel launch",
+    )
+    r.add_argument(
+        "--no-batch",
+        action="store_true",
+        help="serial host-crypto verification (no TPU engine)",
+    )
+
+    q = sub.add_parser("request", help="submit request(s) as a client")
+    q.add_argument("ops", nargs="*", help="operations (default: stdin lines)")
+    q.add_argument("--client-id", type=int, default=_env("client_id", 0))
+    q.add_argument("--timeout", type=float, default=_env("timeout", 30.0))
+
+    sub.add_parser("selftest", help="in-process n=4 cluster smoke test")
+
+    t = sub.add_parser(
+        "testnet", help="scaffold keys.yaml + consensus.yaml for a local cluster"
+    )
+    t.add_argument("-n", "--replicas", type=int, default=3)
+    t.add_argument("-f", "--faults", type=int, default=None, help="default (n-1)//2")
+    t.add_argument("--clients", type=int, default=1)
+    t.add_argument("--base-port", type=int, default=42600)
+    t.add_argument("--host", default="127.0.0.1")
+    t.add_argument("-d", "--dir", default=".", help="output directory")
+    t.add_argument(
+        "--usig",
+        choices=("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"),
+        default="auto",
+    )
+    return p
+
+
+def _log_opts(args):
+    from ...core.options import with_log_file, with_log_level
+
+    opts = [with_log_level(getattr(logging, args.log_level.upper()))]
+    if args.log_file:
+        opts.append(with_log_file(args.log_file))
+    return opts
+
+
+async def _run_replica(args) -> int:
+    from ...core import new_replica
+    from ...sample.authentication import KeyStore
+    from ...sample.config import load_config
+    from ...sample.conn.grpc import GrpcReplicaConnector, ReplicaServer
+    from ...sample.requestconsumer import SimpleLedger
+
+    store = KeyStore.load(args.keys)
+    cfg = load_config(args.config)
+    addrs = {p.id: p.addr for p in cfg.peers}
+    if args.id not in addrs:
+        raise SystemExit(f"peer: replica {args.id} not in {args.config} peers[]")
+
+    engine = None
+    batch_signatures = False
+    if not args.no_batch:
+        import jax
+
+        # The batch engine only pays off where the limb kernels beat host
+        # OpenSSL — i.e. on a real accelerator.  On the CPU backend a
+        # single COMMIT would pad to a full unrolled-P256 batch (plus the
+        # kernel's large XLA CPU compile), so fall back to serial host
+        # crypto there exactly as --no-batch does.
+        if jax.default_backend() != "cpu":
+            from ...parallel import BatchVerifier
+
+            engine = BatchVerifier(max_batch=args.batch, buckets=(args.batch,))
+            batch_signatures = True
+
+    auth = store.replica_authenticator(
+        args.id, engine=engine, batch_signatures=batch_signatures
+    )
+    conn = GrpcReplicaConnector("peer")
+    for rid, addr in addrs.items():
+        if rid != args.id:
+            conn.connect_replica(rid, addr)
+    ledger = SimpleLedger()
+    replica = new_replica(
+        args.id, cfg, auth, conn, ledger, opts=_log_opts(args)
+    )
+    server = ReplicaServer(replica)
+    listen = args.listen or addrs[args.id]
+    bound = await server.start(listen)
+    print(f"replica {args.id} serving on {bound}", file=sys.stderr)
+    await replica.start()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-Unix
+            pass
+    await stop.wait()
+    print(f"replica {args.id} shutting down", file=sys.stderr)
+    await replica.stop()
+    await server.stop()
+    await conn.close()
+    return 0
+
+
+async def _run_request(args) -> int:
+    from ...client import new_client
+    from ...sample.authentication import KeyStore
+    from ...sample.config import load_config
+    from ...sample.conn.grpc import connect_many_replicas
+
+    store = KeyStore.load(args.keys)
+    cfg = load_config(args.config)
+    addrs = {p.id: p.addr for p in cfg.peers}
+    if len(addrs) < cfg.n:
+        raise SystemExit("peer: config peers[] does not cover all replicas")
+
+    ops = [op.encode() for op in args.ops]
+    if not ops:
+        ops = [line.rstrip("\n").encode() for line in sys.stdin if line.strip()]
+
+    conn = connect_many_replicas(addrs, kind="client")
+    client = new_client(
+        args.client_id, cfg.n, cfg.f, store.client_authenticator(args.client_id), conn
+    )
+    await client.start()
+    rc = 0
+    try:
+        for op in ops:
+            result = await asyncio.wait_for(client.request(op), args.timeout)
+            print(result.hex())
+    except asyncio.TimeoutError:
+        print("peer: request timed out", file=sys.stderr)
+        rc = 1
+    finally:
+        await client.stop()
+        await conn.close()
+    return rc
+
+
+async def _run_selftest(args) -> int:
+    """In-process n=4/f=1 commit through generated keys + the dummy
+    connector — a deployment smoke test needing no files or sockets."""
+    from ...client import new_client
+    from ...core import new_replica
+    from ...sample.authentication import generate_testnet_keys
+    from ...sample.config import SimpleConfiger
+    from ...sample.conn.inprocess import (
+        InProcessClientConnector,
+        InProcessPeerConnector,
+        make_testnet_stubs,
+    )
+    from ...sample.requestconsumer import SimpleLedger
+
+    n, f = 4, 1
+    store = generate_testnet_keys(n, n_clients=1)
+    cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+    stubs = make_testnet_stubs(n)
+    ledgers = [SimpleLedger() for _ in range(n)]
+    replicas = []
+    for i in range(n):
+        r = new_replica(
+            i,
+            cfg,
+            store.replica_authenticator(i),
+            InProcessPeerConnector(stubs),
+            ledgers[i],
+            opts=_log_opts(args),
+        )
+        stubs[i].assign_replica(r)
+        replicas.append(r)
+    for r in replicas:
+        await r.start()
+    client = new_client(
+        0, n, f, store.client_authenticator(0), InProcessClientConnector(stubs)
+    )
+    await client.start()
+    result = await asyncio.wait_for(client.request(b"selftest"), 60)
+    for _ in range(200):
+        if all(lg.length == 1 for lg in ledgers):
+            break
+        await asyncio.sleep(0.02)
+    ok = all(lg.length == 1 for lg in ledgers)
+    await client.stop()
+    for r in replicas:
+        await r.stop()
+    if not ok:
+        print("selftest FAILED: not all ledgers committed", file=sys.stderr)
+        return 1
+    print(f"selftest ok: request committed on all {n} replicas "
+          f"(usig={store.usig_spec}, result={result.hex()[:16]}…)", file=sys.stderr)
+    return 0
+
+
+def _run_testnet_scaffold(args) -> int:
+    """Write keys.yaml + consensus.yaml for an n-replica local cluster
+    (the docker-entrypoint key-generation step of the reference,
+    sample/docker/docker-entrypoint.sh, as an explicit command)."""
+    from ...sample.authentication import generate_testnet_keys
+
+    f = args.faults if args.faults is not None else (args.replicas - 1) // 2
+    if args.replicas < 2 * f + 1:
+        raise SystemExit(f"peer: n={args.replicas} < 2f+1 with f={f}")
+    os.makedirs(args.dir, exist_ok=True)
+    store = generate_testnet_keys(
+        args.replicas, n_clients=args.clients, usig_spec=args.usig
+    )
+    keys_path = os.path.join(args.dir, "keys.yaml")
+    store.save(keys_path)
+    peers = [
+        {"id": i, "addr": f"{args.host}:{args.base_port + i}"}
+        for i in range(args.replicas)
+    ]
+    cfg = {
+        "protocol": {
+            "n": args.replicas,
+            "f": f,
+            "checkpointPeriod": 0,
+            "logsize": 0,
+            "timeout": {"request": "8s", "prepare": "4s", "viewchange": "8s"},
+        },
+        "peers": peers,
+    }
+    import yaml
+
+    cfg_path = os.path.join(args.dir, "consensus.yaml")
+    with open(cfg_path, "w") as fh:
+        yaml.safe_dump(cfg, fh, sort_keys=False)
+    print(
+        f"wrote {keys_path} (usig={store.usig_spec}) and {cfg_path} "
+        f"(n={args.replicas}, f={f})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "run":
+        return asyncio.run(_run_replica(args))
+    if args.command == "request":
+        return asyncio.run(_run_request(args))
+    if args.command == "selftest":
+        return asyncio.run(_run_selftest(args))
+    if args.command == "testnet":
+        return _run_testnet_scaffold(args)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,32 @@
+"""peer CLI tests (reference sample/peer; run.go/request.go are exercised
+over real sockets by deploy/local_testnet.sh — here the in-process
+surfaces: testnet scaffolding and the selftest cluster)."""
+
+from minbft_tpu.sample.authentication import KeyStore
+from minbft_tpu.sample.config import load_config
+from minbft_tpu.sample.peer.cli import main
+
+
+def test_testnet_scaffold(tmp_path):
+    d = str(tmp_path)
+    rc = main(
+        ["testnet", "-n", "5", "--clients", "2", "-d", d, "--usig", "SOFT_ECDSA",
+         "--base-port", "45100"]
+    )
+    assert rc == 0
+    store = KeyStore.load(f"{d}/keys.yaml")
+    assert len(store.replica_keys) == 5 and len(store.client_keys) == 2
+    cfg = load_config(f"{d}/consensus.yaml")
+    assert cfg.n == 5 and cfg.f == 2
+    assert [p.addr for p in cfg.peers] == [f"127.0.0.1:{45100+i}" for i in range(5)]
+
+
+def test_testnet_rejects_bad_f(tmp_path):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["testnet", "-n", "3", "-f", "2", "-d", str(tmp_path)])
+
+
+def test_selftest_commits():
+    assert main(["selftest"]) == 0
