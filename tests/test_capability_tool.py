@@ -1,0 +1,25 @@
+"""Build + run the native capability probe (reference tools/sgx-capability;
+the exit code is environment-dependent, the report format is not)."""
+
+import os
+import subprocess
+
+import pytest
+
+TOOL_DIR = os.path.join(os.path.dirname(__file__), "..", "tools", "tpu-capability")
+
+
+def test_probe_builds_and_reports():
+    build = subprocess.run(
+        ["make", "check-tpu-capability"], cwd=TOOL_DIR, capture_output=True
+    )
+    if build.returncode != 0:
+        pytest.skip(f"no native toolchain: {build.stderr.decode()[:200]}")
+    run = subprocess.run(
+        [os.path.join(TOOL_DIR, "check-tpu-capability")],
+        capture_output=True,
+        text=True,
+    )
+    assert run.returncode in (0, 1)  # 2 = probe error
+    assert "verdict:" in run.stdout
+    assert "libcrypto loadable:" in run.stdout
