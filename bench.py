@@ -93,6 +93,7 @@ async def _bench_cluster(
     n_requests: int,
     n_clients: int = 64,
     usig_kind: str = "hmac",
+    scheme: str = "ecdsa-p256",
     max_batch: int = 512,
     prefix: str = "e2e",
 ) -> dict:
@@ -121,21 +122,51 @@ async def _bench_cluster(
     # by n.  (A deployed replica would own its engine/chip; the constructor
     # takes per-replica engines for that.)
     # One padded shape (max_batch): every distinct bucket is a separate
-    # compile of the unrolled ECDSA kernel — padding is far cheaper.
+    # kernel compile — padding is far cheaper.
+    #
+    # The e2e phases run the LOOP lowering on every backend: PREPARE
+    # batching amortizes UI verification to ~1 verify per committed
+    # request, so the protocol needs only a tiny fraction of the kernel's
+    # throughput — while each distinct *unrolled* ECDSA/Ed25519 shape costs
+    # minutes of XLA:TPU compile.  The unrolled lowering is measured once,
+    # in the headline kernel phase.
+    from minbft_tpu.ops import lowering
+
+    lowering.set_mode("loop")
+    # Eager tasks (3.12+): most protocol tasks complete without suspending
+    # (memo hits, buffered sends) — running them synchronously at spawn
+    # cuts the event-loop scheduling overhead on the 1-core bench host.
+    if hasattr(asyncio, "eager_task_factory"):
+        asyncio.get_running_loop().set_task_factory(asyncio.eager_task_factory)
     shared = BatchVerifier(max_batch=max_batch, buckets=(max_batch,))
     engines = [shared for _ in range(n)]
-    configer = SimpleConfiger(n=n, f=f, timeout_request=600.0, timeout_prepare=300.0)
-    # Public-key signature checks (REQUEST/REPLY) batch onto the TPU; on
-    # the CPU SIM backend the limb kernel is slower than host OpenSSL, so
-    # sigs stay serial there and only the USIG path exercises the engine.
-    on_tpu = jax.default_backend() != "cpu"
+    configer = SimpleConfiger(
+        n=n,
+        f=f,
+        timeout_request=600.0,
+        timeout_prepare=300.0,
+        batchsize_prepare=256,
+    )
+    # Signature-scheme placement, measured on the tunneled-TPU bench host
+    # (device round-trip ~60ms): USIG UI certificates batch on the TPU —
+    # they sit on the PREPARE/COMMIT path where request batching amortizes
+    # one UI verify over a 256-request PREPARE, and the engine's dedup memo
+    # collapses the n replicas' identical checks to one device lane.
+    # Per-message REQUEST/REPLY signatures stay on host OpenSSL: their
+    # verification gates individual requests, and coupling every request to
+    # a 60ms device round trip costs more than the host verify (measured:
+    # 205 vs 305 req/s).  ``batch_signatures`` stays available for hosts
+    # with PCIe-attached chips.  Exception: the Ed25519 config exists to
+    # exercise the batched Ed25519 signature kernel, so it opts in.
+    batch_sigs = scheme == "ed25519" and jax.default_backend() != "cpu"
     replica_auths, client_auths = new_test_authenticators(
         n,
         n_clients=n_clients,
+        scheme=scheme,
         usig_kind=usig_kind,
         engines=engines,
-        batch_signatures=on_tpu,
-        client_engine=shared if on_tpu else None,
+        batch_signatures=batch_sigs,
+        client_engine=shared if batch_sigs else None,
     )
     stubs = make_testnet_stubs(n)
     ledgers = [SimpleLedger() for _ in range(n)]
@@ -162,9 +193,18 @@ async def _bench_cluster(
     per_client = n_requests // n_clients
     n_requests = per_client * n_clients
 
+    # Each client pipelines `depth` requests (client/client.py pending map);
+    # total in-flight = n_clients * depth is what fills PREPARE batches.
+    depth = 5
+
     async def drive(client) -> None:
-        for k in range(per_client):
-            await asyncio.wait_for(client.request(b"op-%d" % k), timeout=600)
+        for k0 in range(0, per_client, depth):
+            await asyncio.gather(
+                *[
+                    asyncio.wait_for(client.request(b"op-%d" % k), timeout=600)
+                    for k in range(k0, min(k0 + depth, per_client))
+                ]
+            )
 
     t0 = time.time()
     await asyncio.gather(*[drive(c) for c in clients])
@@ -176,26 +216,54 @@ async def _bench_cluster(
             agg = batch_stats.setdefault(name, {"items": 0, "batches": 0})
             agg["items"] += st.items
             agg["batches"] += st.batches
-    scheme = "hmac_sha256" if usig_kind == "hmac" else "ecdsa_p256"
+    usig_queue = "hmac_sha256" if usig_kind == "hmac" else "ecdsa_p256"
+    sig_stats = batch_stats.get("ed25519") if scheme == "ed25519" else None
 
+    # Clients finish on f+1 matching replies; up to n-(f+1) replicas may
+    # still be draining their pipelines.  Wait for convergence before the
+    # invariant check (the throughput clock above is client-observed and
+    # already stopped).
+    deadline = time.time() + 60
+    while time.time() < deadline and not all(
+        lg.length >= n_requests + 1 for lg in ledgers
+    ):
+        await asyncio.sleep(0.05)
     for client in clients:
         await client.stop()
     for r in replicas:
         await r.stop()
+    lowering.set_mode(None)
     # Every replica must have executed every committed request (plus the
     # warmup) — catches partial-batch execution on backups that f+1
     # matching replies alone would mask.
     assert all(lg.length >= n_requests + 1 for lg in ledgers), [
         lg.length for lg in ledgers
     ]
+    from minbft_tpu.utils.metrics import aggregate
+
+    agg = aggregate(r.metrics.snapshot() for r in replicas)
     return {
+        f"{prefix}_exec_latency_p50_ms": agg.get("execute_latency_p50_ms", 0),
+        f"{prefix}_exec_latency_p99_ms": agg.get("execute_latency_p99_ms", 0),
+        f"{prefix}_messages_handled": agg.get("messages_handled", 0),
+        f"{prefix}_messages_dropped": agg.get("messages_dropped", 0),
         f"{prefix}_n": n,
         f"{prefix}_f": f,
         f"{prefix}_clients": n_clients,
         f"{prefix}_requests": n_requests,
         f"{prefix}_committed_req_per_sec": round(n_requests / dt, 1),
-        f"{prefix}_batched_verifies": batch_stats.get(scheme, {}).get("items", 0),
-        f"{prefix}_batches": batch_stats.get(scheme, {}).get("batches", 0),
+        f"{prefix}_batched_verifies": batch_stats.get(usig_queue, {}).get("items", 0),
+        f"{prefix}_batches": batch_stats.get(usig_queue, {}).get("batches", 0),
+        # For the Ed25519 config, the signature queue is the one the config
+        # exists to exercise — report it alongside the USIG queue.
+        **(
+            {
+                f"{prefix}_sig_batched_verifies": sig_stats["items"],
+                f"{prefix}_sig_batches": sig_stats["batches"],
+            }
+            if sig_stats
+            else {}
+        ),
     }
 
 
@@ -214,8 +282,60 @@ def main() -> None:
     ecdsa = bench_ecdsa(batch)
     extras.update(ecdsa)
     if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
+        # BASELINE.md config 3 (the north star): n=7/f=3, 10k requests,
+        # ECDSA-P256, COMMIT-phase verification batched on the chip.
         extras.update(
-            asyncio.run(_bench_cluster(7, 3, n_requests, n_clients=n_clients))
+            asyncio.run(
+                _bench_cluster(
+                    7, 3, n_requests, n_clients=n_clients, usig_kind="ecdsa"
+                )
+            )
+        )
+    if not os.environ.get("MINBFT_BENCH_SKIP_CONFIGS") and (
+        jax.default_backend() != "cpu" or os.environ.get("MINBFT_BENCH_ALL_CONFIGS")
+    ):
+        # The remaining BASELINE.md table rows.  Request counts are scaled
+        # down by default (env-overridable) to keep the bench inside its
+        # window; each reports committed req/s, which is rate-like and
+        # meaningful at any duration.
+        cfg2_req = int(os.environ.get("MINBFT_BENCH_CFG2_REQUESTS", "1000"))
+        cfg4_req = int(os.environ.get("MINBFT_BENCH_CFG4_REQUESTS", "2000"))
+        cfg5_req = int(os.environ.get("MINBFT_BENCH_CFG5_REQUESTS", "1000"))
+        # config 2: n=4/f=1, ECDSA-P256 authenticator; USIG UIs batch on
+        # the ECDSA kernel, REQUEST/REPLY signatures on host (the measured
+        # placement — see _bench_cluster).  Shares the 512-bucket with
+        # config 3, so no extra ECDSA compile.
+        extras.update(
+            asyncio.run(
+                _bench_cluster(
+                    4, 1, cfg2_req, n_clients=min(n_clients, 50),
+                    usig_kind="ecdsa", prefix="cfg2",
+                )
+            )
+        )
+        # config 4: n=13/f=6, mixed-scheme verification — ECDSA-P256
+        # signatures + HMAC-SHA256 USIG UIs co-resident in the engine,
+        # batch bucket 128.
+        extras.update(
+            asyncio.run(
+                _bench_cluster(
+                    13, 6, cfg4_req, n_clients=min(n_clients, 50),
+                    usig_kind="hmac", max_batch=128, prefix="cfg4",
+                )
+            )
+        )
+        # config 5: n=31/f=15, Ed25519 signature scheme, sustained stream,
+        # batch bucket 1024 (HMAC USIG keeps the UI path off the Ed25519
+        # queue so the signature batches are what fills).
+        extras.update(
+            asyncio.run(
+                _bench_cluster(
+                    31, 15, cfg5_req, n_clients=min(n_clients, 50),
+                    usig_kind="hmac", scheme="ed25519",
+                    max_batch=int(os.environ.get("MINBFT_BENCH_CFG5_BATCH", "1024")),
+                    prefix="cfg5",
+                )
+            )
         )
 
     value = ecdsa["ecdsa_verifies_per_sec"]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import OrderedDict
 from typing import AsyncIterator, Dict, Optional
 
@@ -44,6 +45,7 @@ from . import prepare as prepare_mod
 from . import request as request_mod
 from . import timeout as timeout_mod
 from . import usig_ui, utils
+from ..utils.metrics import ReplicaMetrics
 from .internal.clientstate import ClientStates
 from .internal.messagelog import MessageLog
 from .internal.peerstate import PeerStates
@@ -119,6 +121,7 @@ class Handlers:
         self.view_state = ViewState()
         self.pending = RequestList()
         self._ui_lock = asyncio.Lock()
+        self.metrics = ReplicaMetrics()
 
         # Verified-check memo: a COMMIT re-validates its embedded PREPARE
         # (which re-validates the embedded REQUEST), so the same
@@ -192,6 +195,7 @@ class Handlers:
             timeout = configer.timeout_request
 
             def on_expiry() -> None:
+                self.metrics.inc("timeouts_request")
                 self.log.warning(
                     "request timeout for client %d seq %d", req.client_id, req.seq
                 )
@@ -207,6 +211,7 @@ class Handlers:
             timeout = configer.timeout_prepare
 
             def on_expiry() -> None:
+                self.metrics.inc("timeouts_prepare")
                 # Forward the starved request to the primary
                 # (reference core/request.go:315-324).
                 primary = view % n
@@ -239,7 +244,7 @@ class Handlers:
         def add_reply(reply: Reply) -> None:
             self.client_states.client(reply.client_id).add_reply(reply.seq, reply)
 
-        self.execute_request = request_mod.make_request_executor(
+        base_execute = request_mod.make_request_executor(
             replica_id,
             retire_seq,
             self.pending,
@@ -248,6 +253,14 @@ class Handlers:
             sign_message,
             add_reply,
         )
+
+        async def execute_counted(req: Request) -> None:
+            t0 = time.monotonic()
+            await base_execute(req)
+            self.metrics.observe_execute(time.monotonic() - t0)
+            self.metrics.inc("requests_executed")
+
+        self.execute_request = execute_counted
 
         self._prepare_batcher = _PrepareBatcher(
             replica_id,
@@ -274,9 +287,15 @@ class Handlers:
         )
 
         # --- commit pipeline / quorum
-        self.collect_commitment = commit_mod.make_commitment_collector(
+        base_collect = commit_mod.make_commitment_collector(
             f, self.execute_request
         )
+
+        async def collect_counted(peer_id: int, prepare: Prepare) -> None:
+            self.metrics.inc("commitments_counted")
+            await base_collect(peer_id, prepare)
+
+        self.collect_commitment = collect_counted
         self.apply_commit = commit_mod.make_commit_applier(self.collect_commitment)
 
         # --- prepare pipeline
@@ -306,6 +325,9 @@ class Handlers:
         async with self._ui_lock:
             if isinstance(msg, (Prepare, Commit)):
                 self.assign_ui(msg)
+                self.metrics.inc(
+                    "prepares_sent" if isinstance(msg, Prepare) else "commits_sent"
+                )
             self.message_log.append(msg)
 
     def _broadcast_signed(self, msg: Message) -> None:
@@ -378,6 +400,7 @@ class Handlers:
     async def handle_client_message(self, msg: Message) -> Reply:
         if not isinstance(msg, Request):
             raise api.AuthenticationError("client stream accepts only REQUEST")
+        self.metrics.inc("messages_handled")
         await self.validate_message(msg)
         await self.process_message(msg)
         # Reply once executed (even to a duplicate request — the client may
@@ -386,6 +409,7 @@ class Handlers:
 
     async def handle_peer_message(self, msg: Message) -> None:
         if isinstance(msg, (Prepare, Commit, ReqViewChange, Request)):
+            self.metrics.inc("messages_handled")
             await self.validate_message(msg)
             await self.process_message(msg)
         else:
@@ -510,10 +534,11 @@ class PeerStreamHandler(api.MessageStreamHandler):
         # Also consume (and process) any further messages the peer sends on
         # this stream (the reference's separate incoming direction) — each
         # in its own task so their validations co-batch.
-        proc = _ConcurrentStreamProcessor(
-            h.handle_peer_message,
-            lambda e: h.log.warning("dropping peer message: %s", e),
-        )
+        def _drop_peer(e: Exception) -> None:
+            h.metrics.inc("messages_dropped")
+            h.log.warning("dropping peer message: %s", e)
+
+        proc = _ConcurrentStreamProcessor(h.handle_peer_message, _drop_peer)
 
         async def consume_incoming() -> None:
             async for data in in_stream:
@@ -554,10 +579,11 @@ class ClientStreamHandler(api.MessageStreamHandler):
         # round-trip each, and a pipelined client sends many requests per
         # stream), bounded + pruned by the stream processor so a request
         # flood cannot grow replica memory without bound.
-        proc = _ConcurrentStreamProcessor(
-            handle_one,
-            lambda e: h.log.warning("dropping client message: %s", e),
-        )
+        def _drop_client(e: Exception) -> None:
+            h.metrics.inc("messages_dropped")
+            h.log.warning("dropping client message: %s", e)
+
+        proc = _ConcurrentStreamProcessor(handle_one, _drop_client)
 
         async def consume() -> None:
             async for data in in_stream:
@@ -616,23 +642,36 @@ async def run_peer_connection(
 ) -> None:
     """Client side of a peer connection: send HELLO, process the peer's
     reply stream (reference startPeerConnection,
-    core/message-handling.go:269-290)."""
+    core/message-handling.go:269-290).
+
+    Messages are handled concurrently (one bounded task each), like the
+    server-side pumps: this stream carries the peer's whole broadcast log —
+    the primary's PREPAREs and every peer's COMMITs — and serial handling
+    here would head-of-line-block on each quorum round-trip, starving the
+    verification batches.  Per-peer processing *order* is still enforced
+    downstream by in-order UI capture."""
 
     async def outgoing() -> AsyncIterator[bytes]:
         yield marshal(Hello(replica_id=handlers.replica_id))
         # Keep the stream open until shutdown.
         await done.wait()
 
+    def _drop(e: Exception) -> None:
+        handlers.metrics.inc("messages_dropped")
+        if isinstance(e, api.AuthenticationError):
+            handlers.log.warning("peer %d message rejected: %s", peer_id, e)
+        else:
+            handlers.log.error("peer %d message failed: %r", peer_id, e)
+
+    proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop)
     try:
         async for data in stream_handler.handle_message_stream(outgoing()):
             if done.is_set():
                 break
-            try:
-                msg = unmarshal(data)
-                await handlers.handle_peer_message(msg)
-            except api.AuthenticationError as e:
-                handlers.log.warning("peer %d message rejected: %s", peer_id, e)
+            await proc.submit(data)
     except asyncio.CancelledError:
         raise
     except Exception:
         handlers.log.exception("peer %d connection failed", peer_id)
+    finally:
+        proc.cancel()

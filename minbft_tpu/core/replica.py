@@ -66,6 +66,11 @@ class _Replica(api.Replica):
             logger or make_logger(replica_id),
         )
 
+    @property
+    def metrics(self):
+        """Protocol counters + latency (minbft_tpu.utils.metrics)."""
+        return self.handlers.metrics
+
     def peer_message_stream_handler(self) -> api.MessageStreamHandler:
         return message_handling.PeerStreamHandler(self.handlers)
 

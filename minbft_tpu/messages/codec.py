@@ -19,6 +19,7 @@ pipeline off the critical path while the TPU does the crypto.
 from __future__ import annotations
 
 import struct
+from collections import OrderedDict
 from typing import Tuple
 
 from .message import (
@@ -152,12 +153,40 @@ def marshal(m: Message) -> bytes:
     raise CodecError(f"unknown message type {type(m)!r}")
 
 
+# Decode interning: the same REQUEST bytes arrive once from the client and
+# again embedded in the PREPARE and in every COMMIT (which embeds the full
+# PREPARE) — on a receiving replica that's ~n parses of identical bytes per
+# message.  Interning by exact wire bytes collapses them to one parse, and
+# the shared object also shares its authen-bytes/marshal memos.  Safe
+# because received messages are never mutated (signatures/UIs are assigned
+# only to own generated messages, pre-serialization).  LRU bounded by
+# *accumulated key bytes*, not entry count: a batched PREPARE's wire bytes
+# are O(batch * request size), so an entry-count cap could retain hundreds
+# of MB.
+_INTERN_MAX_BYTES = 32 * 1024 * 1024
+_intern: "OrderedDict[bytes, Message]" = OrderedDict()
+_intern_bytes = 0
+_INTERNABLE = (_TAG_REQUEST, _TAG_PREPARE)
+
+
 def unmarshal(data: bytes) -> Message:
     """Parse canonical bytes back into a typed message
     (reference messages.MessageImpl.NewFromBinary, messages/api.go:26)."""
+    global _intern_bytes
+    if data and data[0] in _INTERNABLE:
+        m = _intern.get(data)
+        if m is not None:
+            _intern.move_to_end(data)
+            return m
     m, off = _unmarshal_at(data, 0)
     if off != len(data):
         raise CodecError("trailing bytes after message")
+    if data[0] in _INTERNABLE and len(data) < _INTERN_MAX_BYTES // 4:
+        _intern[data] = m
+        _intern_bytes += len(data)
+        while _intern_bytes > _INTERN_MAX_BYTES:
+            evicted, _ = _intern.popitem(last=False)
+            _intern_bytes -= len(evicted)
     return m
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -40,6 +41,20 @@ def _bucket_for(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+class _Resolved:
+    """Pre-resolved awaitable — a memo hit costs no Future machinery."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v: bool):
+        self.v = v
+
+    def __await__(self):
+        if False:  # pragma: no cover — makes this a generator function
+            yield
+        return self.v
+
+
 @dataclasses.dataclass
 class VerifyStats:
     """Engine counters (the observability the reference lacks, SURVEY.md §5)."""
@@ -49,6 +64,7 @@ class VerifyStats:
     max_batch_seen: int = 0
     padded_lanes: int = 0
     device_time_s: float = 0.0
+    memo_hits: int = 0
 
     @property
     def mean_batch(self) -> float:
@@ -56,7 +72,18 @@ class VerifyStats:
 
 
 class _SchemeQueue:
-    """Pending verifications for one scheme, with ship-when-idle flush."""
+    """Pending verifications for one scheme, with ship-when-idle flush.
+
+    Verification is a pure function of the item, and one engine typically
+    serves a whole cluster (BASELINE.json: one chip verifies for all n
+    replicas), so identical items are deduplicated: a memo LRU returns
+    known verdicts instantly, and an in-flight map lets concurrent
+    duplicates await the same lane instead of occupying n lanes.  (The n
+    replicas of a cluster all verify the same client signature and the
+    same primary UI — dedup turns those n device verifies into one.)
+    """
+
+    _MEMO_CAP = 16384
 
     def __init__(self, engine: "BatchVerifier", name: str, dispatch):
         self.engine = engine
@@ -66,10 +93,26 @@ class _SchemeQueue:
         self._flush_handle: Optional[asyncio.Handle] = None
         self.inflight = 0
         self.stats = VerifyStats()
+        self._memo: "OrderedDict[object, bool]" = OrderedDict()
+        self._inflight_futs: Dict[object, asyncio.Future] = {}
 
-    def submit(self, item) -> asyncio.Future:
+    def submit(self, item) -> "asyncio.Future | _Resolved":
+        verdict = self._memo.get(item)
+        if verdict is not None:
+            self._memo.move_to_end(item)
+            self.stats.memo_hits += 1
+            return _Resolved(verdict)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
+        waiters = self._inflight_futs.get(item)
+        if waiters is not None:
+            # Every duplicate awaiter gets its OWN future (resolved
+            # together): sharing one future would let any awaiter's task
+            # cancellation cancel it for all of them.
+            self.stats.memo_hits += 1
+            waiters.append(fut)
+            return fut
+        self._inflight_futs[item] = [fut]
         self.pending.append((item, fut))
         if len(self.pending) >= self.engine.max_batch:
             self._flush_now()
@@ -103,9 +146,10 @@ class _SchemeQueue:
         try:
             results = await asyncio.to_thread(self.dispatch, items)
         except Exception as e:  # resolve all futures with the failure
-            for _, fut in batch:
-                if not fut.done():
-                    fut.set_exception(e)
+            for it, _ in batch:
+                for fut in self._inflight_futs.pop(it, ()):
+                    if not fut.done():
+                        fut.set_exception(e)
             return
         finally:
             self.inflight -= 1
@@ -117,9 +161,15 @@ class _SchemeQueue:
         st.batches += 1
         st.max_batch_seen = max(st.max_batch_seen, len(batch))
         st.device_time_s += dt
-        for (_, fut), ok in zip(batch, results):
-            if not fut.done():
-                fut.set_result(bool(ok))
+        memo = self._memo
+        for (it, _), ok in zip(batch, results):
+            ok = bool(ok)
+            memo[it] = ok  # pure function: verdicts (both ways) are stable
+            for fut in self._inflight_futs.pop(it, ()):
+                if not fut.done():
+                    fut.set_result(ok)
+        while len(memo) > self._MEMO_CAP:
+            memo.popitem(last=False)
 
 
 class BatchVerifier:

@@ -4,11 +4,18 @@
 schema of the reference's consensus.yaml (protocol.{n,f,checkpointPeriod,
 logsize,timeout.{request,prepare,viewchange}}, peers[] with id/addr) via
 PyYAML (baked into the runtime image).
+
+Layering (the viper equivalent, reference viperconfiger.go + root.go env
+binding): ``CONSENSUS_*`` environment variables override file values —
+``CONSENSUS_TIMEOUT_REQUEST=5s``, ``CONSENSUS_CHECKPOINT_PERIOD=16``, etc.
+The quorum shape (n, f) is deliberately NOT env-overridable: it must be
+identical cluster-wide and belongs to the shared file.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional
 
 from .. import api
@@ -30,6 +37,7 @@ class SimpleConfiger(api.Configer):
         timeout_request: float = 2.0,
         timeout_prepare: float = 1.0,
         peers: Optional[List[PeerAddr]] = None,
+        batchsize_prepare: int = 64,
     ):
         self._n = n
         self._f = f
@@ -38,6 +46,9 @@ class SimpleConfiger(api.Configer):
         self._timeout_request = timeout_request
         self._timeout_prepare = timeout_prepare
         self.peers = peers or []
+        # Max requests coalesced into one PREPARE (this build's request
+        # batching; the reference has none — roadmap README.md:505).
+        self.batchsize_prepare = batchsize_prepare
 
     @property
     def n(self) -> int:
@@ -64,8 +75,11 @@ class SimpleConfiger(api.Configer):
         return self._timeout_prepare
 
 
-def load_config(path: str) -> SimpleConfiger:
-    """Load a consensus.yaml (reference sample/config/consensus.yaml schema)."""
+def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfiger:
+    """Load a consensus.yaml (reference sample/config/consensus.yaml schema),
+    with ``CONSENSUS_*`` env overrides layered on top (see module doc)."""
+    if env is None:
+        env = os.environ
     with open(path) as fh:
         text = fh.read()
     data = _parse_yaml(text)
@@ -75,13 +89,24 @@ def load_config(path: str) -> SimpleConfiger:
         PeerAddr(id=int(p["id"]), addr=str(p["addr"]))
         for p in data.get("peers", [])
     ]
+
+    def layered(env_key: str, file_val, cast):
+        v = env.get(f"CONSENSUS_{env_key}")
+        return cast(v) if v is not None else cast(file_val)
+
     return SimpleConfiger(
         n=int(proto["n"]),
         f=int(proto["f"]),
-        checkpoint_period=int(proto.get("checkpointPeriod", 0)),
-        logsize=int(proto.get("logsize", 0)),
-        timeout_request=_seconds(timeout.get("request", "2s")),
-        timeout_prepare=_seconds(timeout.get("prepare", "1s")),
+        checkpoint_period=layered(
+            "CHECKPOINT_PERIOD", proto.get("checkpointPeriod", 0), int
+        ),
+        logsize=layered("LOGSIZE", proto.get("logsize", 0), int),
+        timeout_request=layered(
+            "TIMEOUT_REQUEST", timeout.get("request", "2s"), _seconds
+        ),
+        timeout_prepare=layered(
+            "TIMEOUT_PREPARE", timeout.get("prepare", "1s"), _seconds
+        ),
         peers=peers,
     )
 

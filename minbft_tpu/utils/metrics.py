@@ -1,0 +1,109 @@
+"""Protocol-level metrics (the observability the reference lacks — SURVEY.md
+§5 notes its only instrumentation is leveled logging, while this build's
+north star is a throughput number, so counters are first-class here).
+
+Design: plain counters + a fixed-size latency reservoir, updated inline from
+the asyncio pipelines (single event loop — no locks needed), snapshot-read
+by benchmarks/operators.  The batch engine keeps its own
+:class:`minbft_tpu.parallel.engine.VerifyStats`; this module covers the
+protocol layer above it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class LatencyReservoir:
+    """Bounded sample of durations (seconds) with streaming count/sum."""
+
+    capacity: int = 2048
+    count: int = 0
+    total_s: float = 0.0
+
+    def __post_init__(self):
+        self._samples: list = []
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if len(self._samples) < self.capacity:
+            self._samples.append(seconds)
+        else:
+            # deterministic decimation: overwrite round-robin
+            self._samples[self.count % self.capacity] = seconds
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, int(q / 100.0 * len(s)))
+        return s[idx]
+
+
+class ReplicaMetrics:
+    """Counters for one replica's protocol activity.
+
+    Counter names (stable API for benchmarks/operators):
+
+    - ``requests_received`` / ``requests_executed``
+    - ``prepares_sent`` / ``prepares_accepted``
+    - ``commits_sent`` / ``commitments_counted``
+    - ``messages_handled`` / ``messages_dropped``
+    - ``timeouts_request`` / ``timeouts_prepare``
+    """
+
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+        self.execute_latency = LatencyReservoir()
+        self._started = time.monotonic()
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def observe_execute(self, seconds: float) -> None:
+        self.execute_latency.observe(seconds)
+
+    @property
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._started
+
+    def executed_per_sec(self) -> float:
+        up = self.uptime_s
+        return self.counters.get("requests_executed", 0) / up if up > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        """Point-in-time view for logs / bench extras."""
+        return {
+            **self.counters,
+            "uptime_s": round(self.uptime_s, 3),
+            "execute_latency_mean_ms": round(self.execute_latency.mean_s * 1e3, 3),
+            "execute_latency_p50_ms": round(
+                self.execute_latency.percentile(50) * 1e3, 3
+            ),
+            "execute_latency_p99_ms": round(
+                self.execute_latency.percentile(99) * 1e3, 3
+            ),
+        }
+
+
+def aggregate(snapshots) -> dict:
+    """Sum counter snapshots across replicas (latency fields are averaged)."""
+    out: dict = {}
+    n = 0
+    for snap in snapshots:
+        n += 1
+        for k, v in snap.items():
+            out[k] = out.get(k, 0) + v
+    if n:
+        for k in list(out):
+            if k.startswith("execute_latency") or k == "uptime_s":
+                out[k] = round(out[k] / n, 3)
+    return out

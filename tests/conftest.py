@@ -22,3 +22,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the crypto kernels are compile-dominated on
+# the CPU backend (a cold ECDSA ladder compile is ~2 min), so warm CI runs
+# should pay zero compiles.  Keyed by HLO, so kernel changes re-compile
+# automatically.  Opt out with MINBFT_TEST_CACHE=0.
+if os.environ.get("MINBFT_TEST_CACHE", "1") != "0":
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "MINBFT_TEST_CACHE_DIR",
+            os.path.expanduser("~/.cache/minbft_jax_cache_tests"),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)

@@ -1,0 +1,51 @@
+"""Config provider tests (reference sample/config/config_test.go:85):
+YAML schema parsing, duration forms, and CONSENSUS_* env layering."""
+
+import pytest
+
+from minbft_tpu.sample.config import load_config
+
+YAML = """\
+protocol:
+  n: 5
+  f: 2
+  checkpointPeriod: 10
+  logsize: 20
+  timeout:
+    request: 1500ms
+    prepare: 2s
+peers:
+  - id: 0
+    addr: 127.0.0.1:9000
+  - id: 1
+    addr: 127.0.0.1:9001
+"""
+
+
+@pytest.fixture
+def cfg_path(tmp_path):
+    p = tmp_path / "consensus.yaml"
+    p.write_text(YAML)
+    return str(p)
+
+
+def test_file_values(cfg_path):
+    cfg = load_config(cfg_path, env={})
+    assert (cfg.n, cfg.f) == (5, 2)
+    assert cfg.checkpoint_period == 10 and cfg.logsize == 20
+    assert cfg.timeout_request == 1.5
+    assert cfg.timeout_prepare == 2.0
+    assert [p.addr for p in cfg.peers] == ["127.0.0.1:9000", "127.0.0.1:9001"]
+
+
+def test_env_layering(cfg_path):
+    env = {
+        "CONSENSUS_TIMEOUT_REQUEST": "5s",
+        "CONSENSUS_CHECKPOINT_PERIOD": "99",
+    }
+    cfg = load_config(cfg_path, env=env)
+    assert cfg.timeout_request == 5.0
+    assert cfg.checkpoint_period == 99
+    # untouched values come from the file
+    assert cfg.timeout_prepare == 2.0
+    assert (cfg.n, cfg.f) == (5, 2)

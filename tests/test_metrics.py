@@ -1,0 +1,97 @@
+"""Protocol metrics (SURVEY.md §5: the reference has no observability; this
+build's counters are load-bearing for the benchmark harness)."""
+
+import asyncio
+
+from minbft_tpu.utils.metrics import LatencyReservoir, ReplicaMetrics, aggregate
+
+
+def test_latency_reservoir_stats():
+    r = LatencyReservoir(capacity=8)
+    for v in [0.01, 0.02, 0.03, 0.04]:
+        r.observe(v)
+    assert r.count == 4
+    assert abs(r.mean_s - 0.025) < 1e-9
+    assert r.percentile(0) == 0.01
+    assert r.percentile(99) == 0.04
+    # overflow decimates but keeps counting
+    for v in [0.05] * 20:
+        r.observe(v)
+    assert r.count == 24
+    assert r.percentile(99) == 0.05
+
+
+def test_aggregate_sums_counters_and_averages_latency():
+    a, b = ReplicaMetrics(), ReplicaMetrics()
+    a.inc("requests_executed", 3)
+    b.inc("requests_executed", 5)
+    a.observe_execute(0.010)
+    b.observe_execute(0.030)
+    agg = aggregate([a.snapshot(), b.snapshot()])
+    assert agg["requests_executed"] == 8
+    assert abs(agg["execute_latency_mean_ms"] - 20.0) < 0.5
+
+
+def test_cluster_populates_counters():
+    """An in-process commit increments the protocol counters on every
+    replica (requests_executed, prepares/commits sent, messages handled)."""
+
+    async def run():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.core import new_replica
+        from minbft_tpu.sample.authentication import new_test_authenticators
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import (
+            InProcessClientConnector,
+            InProcessPeerConnector,
+            make_testnet_stubs,
+        )
+        from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+        n, f = 3, 1
+        cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+        r_auths, c_auths = new_test_authenticators(n, usig_kind="hmac")
+        stubs = make_testnet_stubs(n)
+        ledgers = [SimpleLedger() for _ in range(n)]
+        replicas = []
+        for i in range(n):
+            r = new_replica(
+                i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
+            )
+            stubs[i].assign_replica(r)
+            replicas.append(r)
+        for r in replicas:
+            await r.start()
+        client = new_client(
+            0, n, f, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        await asyncio.wait_for(client.request(b"count-me"), 30)
+        for _ in range(200):
+            if all(
+                r.metrics.counters.get("requests_executed", 0) >= 1
+                for r in replicas
+            ):
+                break
+            await asyncio.sleep(0.02)
+
+        for i, r in enumerate(replicas):
+            snap = r.metrics.snapshot()
+            assert snap.get("requests_executed", 0) >= 1, (i, snap)
+            assert snap.get("messages_handled", 0) >= 1, (i, snap)
+            assert snap.get("execute_latency_p50_ms", 0) >= 0
+        # primary sent the PREPARE; backups sent COMMITs
+        assert replicas[0].metrics.counters.get("prepares_sent", 0) >= 1
+        assert all(
+            r.metrics.counters.get("commits_sent", 0) >= 1 for r in replicas[1:]
+        )
+        # quorum accounting ran everywhere
+        assert all(
+            r.metrics.counters.get("commitments_counted", 0) >= 2 for r in replicas
+        )
+
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())

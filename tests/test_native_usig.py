@@ -73,12 +73,15 @@ def test_native_ui_verifies_on_tpu_batch_path():
     q, payload, sig = usig_verify_items(b"batch-me", good, u.id())
 
     bad_sig = (sig[0], sig[1] ^ 2)
+    # Batch of 8: the same device shape as test_p256's differential batch,
+    # so the two files share one compiled kernel per CI run.
+    items = [(q, payload, sig), (q, payload, bad_sig)] + [(q, payload, sig)] * 6
     lowering.set_mode("loop")
     try:
-        out = p256.verify_batch([(q, payload, sig), (q, payload, bad_sig)])
+        out = p256.verify_batch(items)
     finally:
         lowering.set_mode(None)
-    assert out.tolist() == [True, False]
+    assert out.tolist() == [True, False] + [True] * 6
 
 
 def test_seal_restores_key_and_epoch():
