@@ -34,37 +34,48 @@ import numpy as np
 BASELINE_VERIFIES_PER_SEC = 50_000.0
 
 
-def bench_ecdsa(batch: int) -> dict:
-    from minbft_tpu.ops import p256
+def bench_ecdsa(batch: int, mode: str = "unrolled", prefix: str = "ecdsa") -> dict:
+    """Timing note: on remote-attached devices ``block_until_ready`` can
+    return before the computation finishes, so the clock stops on a forced
+    device→host transfer of the final output — launches execute in order,
+    so that bounds the whole timed stream (the transfer cost is amortized
+    over ``n_iter`` launches)."""
+    from minbft_tpu.ops import lowering, p256
     from minbft_tpu.utils import hostcrypto as hc
 
-    d, q = hc.keygen()
-    digest = hashlib.sha256(b"bench").digest()
-    sig = hc.ecdsa_sign(d, digest)
-    items = [(q, digest, sig)] * batch
-    arrays = [jax.device_put(jnp.asarray(a)) for a in p256.prepare_batch(items)]
-    t0 = time.time()
-    out = p256.ecdsa_verify_kernel(*arrays)
-    out.block_until_ready()
-    compile_s = time.time() - t0
-    assert bool(np.asarray(out).all()), "self-check failed: valid batch rejected"
-    # negative control: corrupted lane must fail
-    bad = [(q, digest, sig)] * 4
-    bad[2] = (q, digest, (sig[0], sig[1] ^ 2))
-    res = p256.verify_batch(bad)
-    assert list(res) == [True, True, False, True], "corrupted-lane self-check failed"
-
-    n_iter = 5
-    t0 = time.time()
-    for _ in range(n_iter):
+    lowering.set_mode(mode)
+    try:
+        d, q = hc.keygen()
+        digest = hashlib.sha256(b"bench").digest()
+        sig = hc.ecdsa_sign(d, digest)
+        items = [(q, digest, sig)] * batch
+        arrays = [jax.device_put(jnp.asarray(a)) for a in p256.prepare_batch(items)]
+        t0 = time.time()
         out = p256.ecdsa_verify_kernel(*arrays)
-    out.block_until_ready()
-    dt = (time.time() - t0) / n_iter
+        ok = np.asarray(out)
+        compile_s = time.time() - t0
+        assert bool(ok.all()), "self-check failed: valid batch rejected"
+        # negative control: corrupted lane must fail
+        bad = [(q, digest, sig)] * 4
+        bad[2] = (q, digest, (sig[0], sig[1] ^ 2))
+        res = p256.verify_batch(bad)
+        assert list(res) == [True, True, False, True], "corrupted-lane self-check"
+
+        n_iter = 20
+        t0 = time.time()
+        for _ in range(n_iter):
+            out = p256.ecdsa_verify_kernel(*arrays)
+        res = np.asarray(out)  # forces completion of the in-order stream
+        dt = (time.time() - t0) / n_iter
+        assert bool(res.all())
+    finally:
+        lowering.set_mode(None)
     return {
-        "ecdsa_batch": batch,
-        "ecdsa_ms_per_batch": round(dt * 1e3, 2),
-        "ecdsa_verifies_per_sec": batch / dt,
-        "ecdsa_compile_s": round(compile_s, 1),
+        f"{prefix}_batch": batch,
+        f"{prefix}_mode": mode,
+        f"{prefix}_ms_per_batch": round(dt * 1e3, 2),
+        f"{prefix}_verifies_per_sec": batch / dt,
+        f"{prefix}_compile_s": round(compile_s, 1),
     }
 
 
@@ -75,15 +86,15 @@ def bench_hmac(batch: int = 8192) -> dict:
     keys = jax.device_put(jnp.asarray(rng.integers(0, 2**32, (batch, 8), dtype=np.uint32)))
     msgs = jax.device_put(jnp.asarray(rng.integers(0, 2**32, (batch, 8), dtype=np.uint32)))
     macs = hmac_sign_kernel(keys, msgs)
-    macs.block_until_ready()
     out = hmac_verify_kernel(keys, msgs, macs)
     assert bool(np.asarray(out).all())
-    n_iter = 20
+    n_iter = 50
     t0 = time.time()
     for _ in range(n_iter):
         out = hmac_verify_kernel(keys, msgs, macs)
-    out.block_until_ready()
+    res = np.asarray(out)  # see bench_ecdsa timing note
     dt = (time.time() - t0) / n_iter
+    assert bool(res.all())
     return {"hmac_batch": batch, "hmac_verifies_per_sec": batch / dt}
 
 
@@ -279,7 +290,11 @@ def main() -> None:
         n_requests = min(n_requests, 500)
 
     extras.update(bench_hmac())
-    ecdsa = bench_ecdsa(batch)
+    # Headline mode "block" (see ops/lowering.py): measured both faster
+    # (122.8k vs 102.8k verifies/s at batch 4096 on v5e) and ~10x cheaper
+    # to compile (42s vs ~7min) than the fully unrolled form.
+    mode = os.environ.get("MINBFT_BENCH_MODE", "block")
+    ecdsa = bench_ecdsa(batch, mode=mode)
     extras.update(ecdsa)
     if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
         # BASELINE.md config 3 (the north star): n=7/f=3, 10k requests,

@@ -299,13 +299,19 @@ class Handlers:
         self.apply_commit = commit_mod.make_commit_applier(self.collect_commitment)
 
         # --- prepare pipeline
-        self.apply_prepare = prepare_mod.make_prepare_applier(
+        base_apply_prepare = prepare_mod.make_prepare_applier(
             replica_id,
             prepare_seq,
             self.collect_commitment,
             self.handle_generated,
             stop_prepare_timer,
         )
+
+        async def apply_prepare_counted(prepare: Prepare) -> None:
+            await base_apply_prepare(prepare)
+            self.metrics.inc("prepares_accepted")
+
+        self.apply_prepare = apply_prepare_counted
         self.validate_prepare = prepare_mod.make_prepare_validator(
             n, self.validate_request, self.verify_ui
         )
@@ -401,6 +407,7 @@ class Handlers:
         if not isinstance(msg, Request):
             raise api.AuthenticationError("client stream accepts only REQUEST")
         self.metrics.inc("messages_handled")
+        self.metrics.inc("requests_received")
         await self.validate_message(msg)
         await self.process_message(msg)
         # Reply once executed (even to a duplicate request — the client may

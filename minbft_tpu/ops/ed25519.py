@@ -180,7 +180,9 @@ def _verify_one(
     return ok_x & ok_y & valid
 
 
-ed25519_verify_kernel = jax.jit(jax.vmap(_verify_one))
+from .lowering import per_mode_jit
+
+ed25519_verify_kernel = per_mode_jit(jax.vmap(_verify_one))
 
 
 # ---------------------------------------------------------------------------

@@ -55,13 +55,16 @@ hmac32_batch = jax.vmap(hmac32)
 hmac32_verify_batch = jax.vmap(hmac32_verify)
 
 
-@jax.jit
+from .lowering import per_mode_jit
+
+
+@per_mode_jit
 def hmac_verify_kernel(keys, msgs, macs):
     """The jitted batch-verify entry point used by the verification engine."""
     return hmac32_verify_batch(keys, msgs, macs)
 
 
-@jax.jit
+@per_mode_jit
 def hmac_sign_kernel(keys, msgs):
     """Batched MAC generation (used by the software USIG and tests)."""
     return hmac32_batch(keys, msgs)

@@ -244,7 +244,9 @@ def _verify_one(
     return ok & ~inf & ~exc & valid
 
 
-_verify_batch = jax.jit(jax.vmap(_verify_one))
+from .lowering import per_mode_jit
+
+_verify_batch = per_mode_jit(jax.vmap(_verify_one))
 
 
 # ---------------------------------------------------------------------------

@@ -71,9 +71,11 @@ def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     for TPU fusion, a ``fori_loop`` with a rolling schedule window for the
     CPU SIM-mode backend.
     """
-    from .lowering import use_unrolled
+    from .lowering import mode
 
-    if use_unrolled():
+    # SHA-256 has two lowerings; the CIOS-specific "block" mode maps to the
+    # unrolled form here (64 rounds of cheap ops compile fast regardless).
+    if mode() != "loop":
         return _compress_unrolled(state, block)
     return _compress_loop(state, block)
 

@@ -106,6 +106,11 @@ def test_unrolled_matches_scan_lowering():
         ref = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
         L.set_mode("unrolled")
         got = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
+        from minbft_tpu.ops import lowering
+
+        lowering.set_mode("block")
+        blk = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
     finally:
         L.set_mode(None)
     assert got == ref
+    assert blk == ref
