@@ -190,20 +190,22 @@ def sub_mod(spec: FieldSpec, a: Fe, b: Fe) -> Fe:
 # ---------------------------------------------------------------------------
 # Montgomery multiplication (lazy-carry CIOS).
 #
-# Two lowerings of the *same* arithmetic:
+# Three lowerings of the *same* arithmetic (measured trade-offs in
+# ops/lowering.py):
 #
-# - ``unrolled``: the 16-iteration CIOS loop fully unrolled at trace time —
-#   one straight-line fused vector program.  This is what TPUs want (Mosaic
-#   compiles it in seconds and fuses it completely), but XLA:CPU's LLVM
-#   backend is superlinear in basic-block size and takes *minutes* on the
-#   full ladder graph.
-# - ``scan``: the identical math with the outer CIOS loop as ``lax.scan``
-#   (16 steps, ~70-op body).  Compiles instantly everywhere; slower on TPU
-#   because the loop is a fusion barrier.  Used on CPU (the test/"SIM mode"
-#   backend).
+# - ``block`` (TPU default): the outer CIOS loop as a 4-step ``lax.scan``
+#   of 4 unrolled iterations each — fastest measured on v5e AND ~10x
+#   cheaper to compile than full unrolling.
+# - ``unrolled``: the 16-iteration loop fully unrolled at trace time into
+#   one straight-line program.  XLA compile time explodes with basic-block
+#   size (minutes for the full ladder graph), and on v5e the giant block
+#   also schedules worse than ``block``.
+# - ``scan``/``loop`` (CPU default): the outer loop as a 16-step
+#   ``lax.scan`` (~70-op body).  Compiles instantly everywhere; the
+#   per-step fusion barrier costs throughput on TPU.
 #
 # Dispatch is by backend at trace time, overridable with ``set_mode`` (the
-# equivalence of the two lowerings is itself under test).
+# equivalence of the three lowerings is itself under test).
 
 
 from .lowering import mode as _lowering_mode
@@ -211,7 +213,7 @@ from .lowering import set_mode as _set_lowering_mode
 
 
 def set_mode(mode):
-    """Force 'unrolled' or 'scan' lowering (None = auto: unrolled off-CPU).
+    """Force a lowering mode (None = auto: 'block' off-CPU, 'loop' on CPU).
 
     Deprecated alias for :func:`minbft_tpu.ops.lowering.set_mode` ('scan'
     maps to 'loop')."""

@@ -52,13 +52,34 @@ def sharded_verifier(scalar_verify: Callable, mesh: Mesh, n_args: int):
 
     The result expects every argument's leading dimension to be a multiple
     of the mesh size (the engine's bucket sizes guarantee this).
+
+    Per-lowering-mode jit (like the single-chip kernel entry points): the
+    mode is read at trace time, so one jit instance would silently reuse
+    whichever mode compiled first at a given shape.
     """
     sh = batch_sharding(mesh)
-    return jax.jit(
-        jax.vmap(scalar_verify),
-        in_shardings=(sh,) * n_args,
-        out_shardings=sh,
-    )
+    batched = jax.vmap(scalar_verify)
+
+    def build():
+        return jax.jit(
+            batched,
+            in_shardings=(sh,) * n_args,
+            out_shardings=sh,
+        )
+
+    cache = {}
+
+    def wrapper(*args):
+        from ..ops import lowering
+
+        m = lowering.mode()
+        fn = cache.get(m)
+        if fn is None:
+            fn = build()
+            cache[m] = fn
+        return fn(*args)
+
+    return wrapper
 
 
 def sharded_ecdsa_kernel(mesh: Mesh):

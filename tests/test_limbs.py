@@ -92,9 +92,9 @@ def test_vmap_batch_matches_scalar():
         assert from_limbs(out[i]) == (x * y * r_inv) % P256_N
 
 
-def test_unrolled_matches_scan_lowering():
-    """The TPU 'unrolled' lowering and the CPU 'scan' lowering are the same
-    arithmetic — pin their equivalence on a handful of values."""
+def test_lowering_modes_agree_unrolled_scan_block():
+    """The three lowerings (unrolled / scan / block) are the same
+    arithmetic — pin their equivalence."""
     from minbft_tpu.ops import limbs as L
 
     spec = FieldSpec.make(P256_P)
@@ -106,9 +106,7 @@ def test_unrolled_matches_scan_lowering():
         ref = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
         L.set_mode("unrolled")
         got = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
-        from minbft_tpu.ops import lowering
-
-        lowering.set_mode("block")
+        L.set_mode("block")
         blk = from_limbs(jax.jit(lambda: L.fe_to_array(mont_mul(spec, at, bt)))())
     finally:
         L.set_mode(None)

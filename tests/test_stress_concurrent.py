@@ -1,0 +1,119 @@
+"""Concurrency stress tests (the reference runs its closures from many
+goroutines under -race: TestMakeCommitmentCollectorConcurrent,
+core/commit_test.go:177, TestMakeGeneratedMessageHandlerConcurrent,
+core/message-handling_test.go:604; asyncio analogue: many interleaving
+tasks, invariants checked at the end)."""
+
+import asyncio
+import random
+
+import pytest
+
+from minbft_tpu.core.commit import make_commitment_collector
+from minbft_tpu.core.internal.clientstate import ClientStates
+from minbft_tpu.messages import Prepare, Request, UI
+
+
+def _prepare(view: int, cv: int, n_reqs: int = 1) -> Prepare:
+    reqs = [
+        Request(client_id=0, seq=cv * 100 + i, operation=b"op", signature=b"s")
+        for i in range(n_reqs)
+    ]
+    return Prepare(
+        replica_id=view % 8, view=view, requests=reqs, ui=UI(counter=cv, cert=b"c")
+    )
+
+
+def test_collector_concurrent_commitments_execute_once_in_order():
+    """Many replicas commit many CVs concurrently (random interleaving):
+    every request executes exactly once, in primary-CV order, after f+1
+    commitments."""
+
+    async def run():
+        f = 3
+        n_cvs = 40
+        replicas = list(range(1, 2 * f + 2))  # f+1 < len, quorums complete
+        executed = []
+
+        async def execute(req):
+            executed.append(req.seq)
+            await asyncio.sleep(0)  # yield: invite reordering bugs
+
+        collect = make_commitment_collector(f, execute)
+
+        async def committer(rid):
+            # each replica commits CVs strictly in order, but replicas
+            # interleave randomly
+            for cv in range(1, n_cvs + 1):
+                await asyncio.sleep(random.random() * 0.001)
+                await collect(rid, _prepare(0, cv, n_reqs=2))
+
+        await asyncio.gather(*[committer(r) for r in replicas])
+        expect = [cv * 100 + i for cv in range(1, n_cvs + 1) for i in range(2)]
+        assert executed == expect
+
+    asyncio.run(run())
+
+
+def test_collector_rejects_cv_gap_under_concurrency():
+    async def run():
+        collect = make_commitment_collector(1, lambda req: asyncio.sleep(0))
+        await collect(1, _prepare(0, 1))
+        with pytest.raises(Exception):
+            await collect(1, _prepare(0, 3))  # skips CV 2
+
+    asyncio.run(run())
+
+
+def test_clientstate_concurrent_capture_many_clients():
+    """Captures for distinct clients proceed in parallel; per client the
+    blocking gate serializes seqs (reference request-seq.go:47-82)."""
+
+    async def run():
+        states = ClientStates()
+        n_clients, n_seqs = 20, 10
+        order = {c: [] for c in range(n_clients)}
+
+        async def client_flow(c):
+            for seq in range(1, n_seqs + 1):
+                new = await states.client(c).capture_request_seq(seq)
+                assert new
+                order[c].append(seq)
+                await asyncio.sleep(random.random() * 0.001)
+                await states.client(c).release_request_seq(seq)
+                states.client(c).retire_request_seq(seq)
+
+        await asyncio.gather(*[client_flow(c) for c in range(n_clients)])
+        assert all(order[c] == list(range(1, n_seqs + 1)) for c in order)
+
+    asyncio.run(run())
+
+
+def test_generated_ui_counters_match_log_order():
+    """Concurrent generated PREPAREs get UI counters in log-append order
+    (the reference's uiLock invariant, core/message-handling.go:552-563)."""
+
+    async def run():
+        from minbft_tpu.core.internal.messagelog import MessageLog
+        from minbft_tpu.core.usig_ui import make_ui_assigner
+        from minbft_tpu.sample.authentication import new_test_authenticators
+        from minbft_tpu.usig import ui_from_bytes
+
+        (auth,), _ = new_test_authenticators(1, usig_kind="hmac")
+        assign = make_ui_assigner(auth)
+        log = MessageLog()
+        ui_lock = asyncio.Lock()
+
+        async def generate(i):
+            await asyncio.sleep(random.random() * 0.001)
+            msg = _prepare(0, i + 1)
+            msg.ui = None
+            async with ui_lock:
+                assign(msg)
+                log.append(msg)
+
+        await asyncio.gather(*[generate(i) for i in range(50)])
+        counters = [m.ui.counter for m in log.snapshot()]
+        assert counters == list(range(1, 51))
+
+    asyncio.run(run())
