@@ -79,6 +79,35 @@ def bench_ecdsa(batch: int, mode: str = "unrolled", prefix: str = "ecdsa") -> di
     }
 
 
+def bench_ecdsa_sign(batch: int) -> dict:
+    """Batched signing: device does k*G, host finishes (r, s) — see
+    ops/p256.py sign_batch."""
+    from minbft_tpu.ops import lowering, p256
+    from minbft_tpu.utils import hostcrypto as hc
+
+    lowering.set_mode(os.environ.get("MINBFT_BENCH_MODE", "block"))
+    try:
+        d, _ = hc.keygen()
+        digest = hashlib.sha256(b"sign-bench").digest()
+        items = [(d, digest)] * batch
+        t0 = time.time()
+        sigs = p256.sign_batch(items)
+        compile_s = time.time() - t0
+        assert all(s == sigs[0] for s in sigs)
+        n_iter = 3
+        t0 = time.time()
+        for _ in range(n_iter):
+            sigs = p256.sign_batch(items)
+        dt = (time.time() - t0) / n_iter
+    finally:
+        lowering.set_mode(None)
+    return {
+        "ecdsa_sign_batch": batch,
+        "ecdsa_signs_per_sec": batch / dt,
+        "ecdsa_sign_compile_s": round(compile_s, 1),
+    }
+
+
 def bench_hmac(batch: int = 8192) -> dict:
     from minbft_tpu.ops.hmac_sha256 import hmac_sign_kernel, hmac_verify_kernel
 
@@ -296,6 +325,8 @@ def main() -> None:
     mode = os.environ.get("MINBFT_BENCH_MODE", "block")
     ecdsa = bench_ecdsa(batch, mode=mode)
     extras.update(ecdsa)
+    if not os.environ.get("MINBFT_BENCH_SKIP_SIGN"):
+        extras.update(bench_ecdsa_sign(min(batch, 2048)))
     if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
         # BASELINE.md config 3 (the north star): n=7/f=3, 10k requests,
         # ECDSA-P256, COMMIT-phase verification batched on the chip.
