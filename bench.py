@@ -79,13 +79,13 @@ def bench_ecdsa(batch: int, mode: str = "unrolled", prefix: str = "ecdsa") -> di
     }
 
 
-def bench_ecdsa_sign(batch: int) -> dict:
+def bench_ecdsa_sign(batch: int, mode: str = "block") -> dict:
     """Batched signing: device does k*G, host finishes (r, s) — see
     ops/p256.py sign_batch."""
     from minbft_tpu.ops import lowering, p256
     from minbft_tpu.utils import hostcrypto as hc
 
-    lowering.set_mode(os.environ.get("MINBFT_BENCH_MODE", "block"))
+    lowering.set_mode(mode)
     try:
         d, _ = hc.keygen()
         digest = hashlib.sha256(b"sign-bench").digest()
@@ -326,7 +326,7 @@ def main() -> None:
     ecdsa = bench_ecdsa(batch, mode=mode)
     extras.update(ecdsa)
     if not os.environ.get("MINBFT_BENCH_SKIP_SIGN"):
-        extras.update(bench_ecdsa_sign(min(batch, 2048)))
+        extras.update(bench_ecdsa_sign(min(batch, 2048), mode=mode))
     if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
         # BASELINE.md config 3 (the north star): n=7/f=3, 10k requests,
         # ECDSA-P256, COMMIT-phase verification batched on the chip.

@@ -349,20 +349,30 @@ def _batch_inv(vals: list, mod: int) -> list:
 
 def sign_batch(
     items: Sequence[Tuple[int, bytes]],
+    bucket: int = 0,
 ) -> list:
     """[(private scalar d, digest32)] -> [(r, s)] — RFC 6979 deterministic,
-    byte-identical to :func:`minbft_tpu.utils.hostcrypto.ecdsa_sign_py`."""
+    byte-identical to :func:`minbft_tpu.utils.hostcrypto.ecdsa_sign_py`.
+
+    ``bucket`` pads the device batch to a fixed size (pad lanes compute
+    1*G and are discarded) so varying batch sizes share one compiled
+    kernel — hot-path callers must pass their bucket ladder's size, like
+    the verify path's engine buckets."""
     from ..utils import hostcrypto as hc
 
     b = len(items)
+    pad = max(bucket, b) - b
     ks = []
-    k_arr = np.zeros((b, limbs.NLIMBS), np.uint32)
+    k_arr = np.zeros((b + pad, limbs.NLIMBS), np.uint32)
     for i, (d, digest) in enumerate(items):
         z = int.from_bytes(digest[:32], "big") % N
         k = hc._rfc6979_k(d, z)
         ks.append((d, z, k))
         k_arr[i] = to_limbs(k)
-    xz = np.asarray(ecdsa_kg_kernel(jnp.asarray(k_arr))).astype("<u2")  # [B,2,16]
+    if pad:
+        k_arr[b:, 0] = 1  # k = 1: a valid lane, result discarded
+    xz = np.asarray(ecdsa_kg_kernel(jnp.asarray(k_arr))).astype("<u2")
+    xz = xz[:b]  # [B,2,16]
     # Vectorized limb→int: uint16 rows → little-endian bytes → one
     # int.from_bytes per row (a per-limb shift-sum costs ~250us/row).
     x_ints = [int.from_bytes(row.tobytes(), "little") for row in xz[:, 0]]

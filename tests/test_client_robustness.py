@@ -98,25 +98,50 @@ def test_request_timeout_without_retransmit():
     asyncio.run(run())
 
 
-def test_duplicate_request_gets_reply_again():
-    """A replica replies to a duplicate REQUEST (the client may be retrying
-    a lost reply — reference message-handling.go:396-403); the ledger
-    executes it once."""
+class _DuplicatingConnector(api.ReplicaConnector):
+    """Delivers every outgoing message twice — guarantees the replicas'
+    duplicate-REQUEST path executes (no timing luck involved)."""
+
+    def __init__(self, inner: api.ReplicaConnector):
+        self._inner = inner
+
+    def replica_message_stream_handler(self, replica_id):
+        inner_handler = self._inner.replica_message_stream_handler(replica_id)
+        if inner_handler is None:
+            return None
+
+        class _Dup(api.MessageStreamHandler):
+            async def handle_message_stream(self, in_stream):
+                async def doubled():
+                    async for data in in_stream:
+                        yield data
+                        yield data  # the duplicate
+
+                async for out in inner_handler.handle_message_stream(doubled()):
+                    yield out
+
+        return _Dup()
+
+
+def test_duplicate_request_replied_but_executed_once():
+    """Replicas reply to a duplicate REQUEST (the client may be retrying a
+    lost reply — reference message-handling.go:396-403) but execute it
+    exactly once."""
 
     async def run():
         replicas, c_auths, stubs, ledgers = await _cluster()
-        client = new_client(
-            0, 4, 1, c_auths[0], InProcessClientConnector(stubs),
-            seq_start=0, retransmit_interval=0.05,
-        )
+        conn = _DuplicatingConnector(InProcessClientConnector(stubs))
+        client = new_client(0, 4, 1, c_auths[0], conn, seq_start=0)
         await client.start()
-        await asyncio.wait_for(client.request(b"once"), 30)
-        # force a visible retransmission storm on a second request
-        r2 = await asyncio.wait_for(client.request(b"twice"), 30)
-        assert r2
-        await asyncio.sleep(0.2)
-        for lg in ledgers:
-            assert lg.length <= 2  # no duplicate execution
+        assert await asyncio.wait_for(client.request(b"once"), 30)
+        assert await asyncio.wait_for(client.request(b"twice"), 30)
+        # let the duplicates drain, then check exactly-once execution
+        await asyncio.sleep(0.3)
+        for _ in range(100):
+            if all(lg.length == 2 for lg in ledgers):
+                break
+            await asyncio.sleep(0.05)
+        assert all(lg.length == 2 for lg in ledgers), [lg.length for lg in ledgers]
         await client.stop()
         for r in replicas:
             await r.stop()

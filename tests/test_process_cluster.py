@@ -1,0 +1,114 @@
+"""Whole-system test: real `peer` CLI replica *processes* over gRPC
+sockets commit a request submitted by the `peer request` CLI — the
+scripted-deployment flow (deploy/local_testnet.sh) as a pytest.
+
+The reference demonstrates this flow manually (README.md:411-458, killing
+processes to show fault tolerance); here it runs under CI on the CPU
+backend with --no-batch (serial host crypto: no kernel compiles in the
+replica processes)."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _wait_ports(ports, timeout=60.0):
+    deadline = time.time() + timeout
+    pending = set(ports)
+    while pending and time.time() < deadline:
+        for port in list(pending):
+            with socket.socket() as s:
+                s.settimeout(0.2)
+                try:
+                    s.connect(("127.0.0.1", port))
+                    pending.discard(port)
+                except OSError:
+                    pass
+        if pending:
+            time.sleep(0.3)
+    return not pending
+
+
+def _free_base_port(count: int) -> int:
+    """Find ``count`` consecutive free ports (close the probes just before
+    use — imperfect but beats a fixed port colliding with a prior run)."""
+    while True:
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            base = probe.getsockname()[1]
+        if base + count < 65535:
+            socks = []
+            try:
+                for i in range(count):
+                    s = socket.socket()
+                    socks.append(s)  # append first so it always gets closed
+                    s.bind(("127.0.0.1", base + i))
+                return base
+            except OSError:
+                continue
+            finally:
+                for s in socks:
+                    s.close()
+
+
+def test_three_process_cluster_commits(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    d = str(tmp_path)
+    base_port = _free_base_port(3)
+
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "3", "-d", d, "--base-port", str(base_port), "--usig", "SOFT_ECDSA"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = []
+    try:
+        for i in range(3):
+            replicas.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "minbft_tpu.sample.peer",
+                     "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+                     "run", str(i), "--no-batch"],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    # not PIPE: an unread pipe fills and blocks the replica
+                    stderr=open(f"{d}/replica{i}.log", "wb"),
+                )
+            )
+        assert _wait_ports([base_port + i for i in range(3)]), "replicas never bound"
+
+        req = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "process-cluster-op", "--timeout", "60"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert req.returncode == 0, req.stderr
+        assert len(req.stdout.strip()) == 64  # hex block digest
+
+        # f=1: kill one backup, the cluster still commits
+        replicas[2].terminate()
+        replicas[2].wait(timeout=10)
+        req2 = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "after-backup-kill", "--timeout", "60"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert req2.returncode == 0, req2.stderr
+    finally:
+        for p in replicas:
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
