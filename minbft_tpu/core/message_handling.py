@@ -235,7 +235,35 @@ class Handlers:
             self.client_states.client(req.client_id).stop_prepare_timer()
 
         # --- request pipeline
-        self.validate_request = request_mod.make_request_validator(verify_signature)
+        base_validate_request = request_mod.make_request_validator(verify_signature)
+
+        # Object-level validation marker: the interned message objects (see
+        # messages/codec.py) arrive repeatedly — a REQUEST via the client
+        # stream, again inside the PREPARE, again inside every COMMIT; the
+        # PREPARE again inside every COMMIT.  A *successful* validation is a
+        # pure function of the message content AND this replica's trusted
+        # keys/config, so the mark is keyed by a token unique to this
+        # Handlers instance — never by replica id, which a restarted or
+        # co-resident cluster would reuse with different keys (the interned
+        # objects are process-global and outlive any one replica).
+        # Failures are never recorded.
+        vtoken = self._validation_token = object()
+
+        def _mark(msg) -> bool:
+            """True if this Handlers already validated ``msg``."""
+            done = msg.__dict__.get("_validated_by")
+            return done is not None and vtoken in done
+
+        def _record(msg) -> None:
+            msg.__dict__.setdefault("_validated_by", set()).add(vtoken)
+
+        async def validate_request_cached(req: Request) -> None:
+            if _mark(req):
+                return
+            await base_validate_request(req)
+            _record(req)
+
+        self.validate_request = validate_request_cached
         capture_seq = request_mod.make_seq_capturer(self.client_states)
         self.release_seq = request_mod.make_seq_releaser(self.client_states)
         prepare_seq = request_mod.make_seq_preparer(self.client_states)
@@ -312,9 +340,17 @@ class Handlers:
             self.metrics.inc("prepares_accepted")
 
         self.apply_prepare = apply_prepare_counted
-        self.validate_prepare = prepare_mod.make_prepare_validator(
+        base_validate_prepare = prepare_mod.make_prepare_validator(
             n, self.validate_request, self.verify_ui
         )
+
+        async def validate_prepare_cached(prepare: Prepare) -> None:
+            if _mark(prepare):
+                return
+            await base_validate_prepare(prepare)
+            _record(prepare)
+
+        self.validate_prepare = validate_prepare_cached
         self.validate_commit = commit_mod.make_commit_validator(
             n, self.validate_prepare, self.verify_ui
         )

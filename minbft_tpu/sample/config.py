@@ -108,6 +108,9 @@ def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfig
             "TIMEOUT_PREPARE", timeout.get("prepare", "1s"), _seconds
         ),
         peers=peers,
+        batchsize_prepare=layered(
+            "BATCHSIZE_PREPARE", proto.get("batchsizePrepare", 64), int
+        ),
     )
 
 

@@ -277,6 +277,7 @@ def _run_testnet_scaffold(args) -> int:
             "f": f,
             "checkpointPeriod": 0,
             "logsize": 0,
+            "batchsizePrepare": 64,
             "timeout": {"request": "8s", "prepare": "4s", "viewchange": "8s"},
         },
         "peers": peers,

@@ -11,6 +11,7 @@ protocol:
   f: 2
   checkpointPeriod: 10
   logsize: 20
+  batchsizePrepare: 128
   timeout:
     request: 1500ms
     prepare: 2s
@@ -33,6 +34,7 @@ def test_file_values(cfg_path):
     cfg = load_config(cfg_path, env={})
     assert (cfg.n, cfg.f) == (5, 2)
     assert cfg.checkpoint_period == 10 and cfg.logsize == 20
+    assert cfg.batchsize_prepare == 128
     assert cfg.timeout_request == 1.5
     assert cfg.timeout_prepare == 2.0
     assert [p.addr for p in cfg.peers] == ["127.0.0.1:9000", "127.0.0.1:9001"]

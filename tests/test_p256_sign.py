@@ -27,3 +27,8 @@ def test_sign_batch_matches_host_and_verifies():
         (q, digest, sig) for (q, digest, _), sig in zip(expected, got)
     ]
     assert list(p256.verify_batch(verify_items)) == [True] * len(items)
+
+    # bucketed call: pads to the same device shape (no extra compile),
+    # pad lanes discarded, results identical
+    got_padded = p256.sign_batch(items[:3], bucket=len(items))
+    assert got_padded == got[:3]

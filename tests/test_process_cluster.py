@@ -69,8 +69,13 @@ def test_three_process_cluster_commits(tmp_path):
     assert scaffold.returncode == 0, scaffold.stderr
 
     replicas = []
+    logs = []
     try:
         for i in range(3):
+            # a log file, not PIPE: an unread pipe fills and blocks the
+            # replica; closed in the finally block
+            log = open(f"{d}/replica{i}.log", "wb")
+            logs.append(log)
             replicas.append(
                 subprocess.Popen(
                     [sys.executable, "-m", "minbft_tpu.sample.peer",
@@ -78,8 +83,7 @@ def test_three_process_cluster_commits(tmp_path):
                      "run", str(i), "--no-batch"],
                     env=env,
                     stdout=subprocess.DEVNULL,
-                    # not PIPE: an unread pipe fills and blocks the replica
-                    stderr=open(f"{d}/replica{i}.log", "wb"),
+                    stderr=log,
                 )
             )
         assert _wait_ports([base_port + i for i in range(3)]), "replicas never bound"
@@ -112,3 +116,5 @@ def test_three_process_cluster_commits(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        for log in logs:
+            log.close()
