@@ -192,12 +192,14 @@ async def _bench_cluster(
     # they sit on the PREPARE/COMMIT path where request batching amortizes
     # one UI verify over a 256-request PREPARE, and the engine's dedup memo
     # collapses the n replicas' identical checks to one device lane.
-    # Per-message REQUEST/REPLY signatures stay on host OpenSSL: their
-    # verification gates individual requests, and coupling every request to
-    # a 60ms device round trip costs more than the host verify (measured:
-    # 205 vs 305 req/s).  ``batch_signatures`` stays available for hosts
-    # with PCIe-attached chips.  Exception: the Ed25519 config exists to
-    # exercise the batched Ed25519 signature kernel, so it opts in.
+    # Per-message REQUEST/REPLY signatures go to the engine's HOST queue
+    # (batch_signatures=False + engine): still deduplicated cluster-wide
+    # (one verify instead of n for each client signature) but with no
+    # device round trip on the per-request critical path — coupling every
+    # request to a 60ms round trip measured slower (205 vs 305 req/s).
+    # ``batch_signatures`` stays available for hosts with PCIe-attached
+    # chips.  Exception: the Ed25519 config exists to exercise the batched
+    # Ed25519 signature kernel, so it opts in.
     batch_sigs = scheme == "ed25519" and jax.default_backend() != "cpu"
     replica_auths, client_auths = new_test_authenticators(
         n,

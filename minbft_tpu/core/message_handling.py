@@ -257,13 +257,16 @@ class Handlers:
         def _record(msg) -> None:
             msg.__dict__.setdefault("_validated_by", set()).add(vtoken)
 
-        async def validate_request_cached(req: Request) -> None:
-            if _mark(req):
-                return
-            await base_validate_request(req)
-            _record(req)
+        def _cached_validator(base):
+            async def validate_cached(msg) -> None:
+                if _mark(msg):
+                    return
+                await base(msg)
+                _record(msg)
 
-        self.validate_request = validate_request_cached
+            return validate_cached
+
+        self.validate_request = _cached_validator(base_validate_request)
         capture_seq = request_mod.make_seq_capturer(self.client_states)
         self.release_seq = request_mod.make_seq_releaser(self.client_states)
         prepare_seq = request_mod.make_seq_preparer(self.client_states)
@@ -340,17 +343,11 @@ class Handlers:
             self.metrics.inc("prepares_accepted")
 
         self.apply_prepare = apply_prepare_counted
-        base_validate_prepare = prepare_mod.make_prepare_validator(
-            n, self.validate_request, self.verify_ui
+        self.validate_prepare = _cached_validator(
+            prepare_mod.make_prepare_validator(
+                n, self.validate_request, self.verify_ui
+            )
         )
-
-        async def validate_prepare_cached(prepare: Prepare) -> None:
-            if _mark(prepare):
-                return
-            await base_validate_prepare(prepare)
-            _record(prepare)
-
-        self.validate_prepare = validate_prepare_cached
         self.validate_commit = commit_mod.make_commit_validator(
             n, self.validate_prepare, self.verify_ui
         )

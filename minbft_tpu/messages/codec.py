@@ -158,8 +158,11 @@ def marshal(m: Message) -> bytes:
 # PREPARE) — on a receiving replica that's ~n parses of identical bytes per
 # message.  Interning by exact wire bytes collapses them to one parse, and
 # the shared object also shares its authen-bytes/marshal memos.  Safe
-# because received messages are never mutated (signatures/UIs are assigned
-# only to own generated messages, pre-serialization).  LRU bounded by
+# because received messages' protocol *fields* are never mutated
+# (signatures/UIs are assigned only to own generated messages,
+# pre-serialization); the only writes to a shared object are idempotent
+# memo attributes (_authen_bytes, _wire_bytes, and the token-keyed
+# _validated_by set from core/message_handling.py).  LRU bounded by
 # *accumulated key bytes*, not entry count: a batched PREPARE's wire bytes
 # are O(batch * request size), so an entry-count cap could retain hundreds
 # of MB.

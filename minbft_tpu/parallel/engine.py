@@ -242,12 +242,27 @@ class BatchVerifier:
         q = self._queue("ecdsa_p256", self._dispatch_ecdsa)
         return await q.submit((pubkey, digest, sig))
 
+    async def verify_ecdsa_p256_host(
+        self, pubkey: Tuple[int, int], digest: bytes, sig: Tuple[int, int]
+    ) -> bool:
+        """Host-dispatched queue: same dedup memo as the device queue (one
+        engine serves the cluster, so the n replicas' identical signature
+        checks collapse to one) without coupling each verification to a
+        device round trip — the right placement for per-message signature
+        checks on hosts where the chip is remote-attached."""
+        q = self._queue("ecdsa_p256_host", self._dispatch_ecdsa_host)
+        return await q.submit((pubkey, digest, sig))
+
     async def verify_hmac_sha256(self, key: bytes, msg32: bytes, mac: bytes) -> bool:
         q = self._queue("hmac_sha256", self._dispatch_hmac)
         return await q.submit((key, msg32, mac))
 
     async def verify_ed25519(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
         q = self._queue("ed25519", self._dispatch_ed25519)
+        return await q.submit((pub, msg, sig))
+
+    async def verify_ed25519_host(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
+        q = self._queue("ed25519_host", self._dispatch_ed25519_host)
         return await q.submit((pub, msg, sig))
 
     # -- dispatchers (worker thread; jax work happens here) -----------------
@@ -291,6 +306,26 @@ class BatchVerifier:
         b = _bucket_for(n, self.buckets)
         self._queues["ed25519"].stats.padded_lanes += b - n
         return ed.verify_batch_padded(list(items), b)[:n]
+
+    # Host dispatchers: serial OpenSSL in the worker thread — no padding,
+    # no device round trip; the queue layer still provides batching of the
+    # thread hops plus the dedup memo.
+
+    def _dispatch_ecdsa_host(self, items) -> np.ndarray:
+        from ..utils import hostcrypto as hc
+
+        return np.array(
+            [hc.ecdsa_verify(q, digest, sig) for q, digest, sig in items],
+            dtype=bool,
+        )
+
+    def _dispatch_ed25519_host(self, items) -> np.ndarray:
+        from ..utils import hostcrypto as hc
+
+        return np.array(
+            [hc.ed25519_verify(pub, msg, sig) for pub, msg, sig in items],
+            dtype=bool,
+        )
 
 
 # A structurally valid-but-failing pad item (valid=False lane).

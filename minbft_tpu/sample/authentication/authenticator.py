@@ -32,14 +32,20 @@ _EPOCH_LEN = 8
 
 class SigScheme:
     """Public-key signature scheme plug-in (reference SignatureCipher +
-    PublicAuthenScheme, sample/authentication/crypto.go:36-126)."""
+    PublicAuthenScheme, sample/authentication/crypto.go:36-126).
+
+    ``verify`` placement: ``engine=None`` verifies inline on the host;
+    with an engine, ``device=True`` joins the TPU batch queue and
+    ``device=False`` the engine's host queue — which still provides the
+    cluster-wide dedup memo (the n replicas check the same client
+    signature once) without the device round trip."""
 
     name = "?"
 
     def sign(self, priv, msg: bytes) -> bytes:
         raise NotImplementedError
 
-    async def verify(self, pub, msg: bytes, tag: bytes, engine) -> bool:
+    async def verify(self, pub, msg: bytes, tag: bytes, engine, device=True) -> bool:
         raise NotImplementedError
 
 
@@ -52,14 +58,16 @@ class EcdsaScheme(SigScheme):
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     async def verify(
-        self, pub: Tuple[int, int], msg: bytes, tag: bytes, engine
+        self, pub: Tuple[int, int], msg: bytes, tag: bytes, engine, device=True
     ) -> bool:
         if len(tag) != 64:
             return False
         digest = hashlib.sha256(msg).digest()
         sig = (int.from_bytes(tag[:32], "big"), int.from_bytes(tag[32:], "big"))
         if engine is not None:
-            return await engine.verify_ecdsa_p256(pub, digest, sig)
+            if device:
+                return await engine.verify_ecdsa_p256(pub, digest, sig)
+            return await engine.verify_ecdsa_p256_host(pub, digest, sig)
         return hc.ecdsa_verify(pub, digest, sig)
 
 
@@ -69,10 +77,14 @@ class Ed25519Scheme(SigScheme):
     def sign(self, priv: bytes, msg: bytes) -> bytes:
         return hc.ed25519_sign(priv, hashlib.sha256(msg).digest())
 
-    async def verify(self, pub: bytes, msg: bytes, tag: bytes, engine) -> bool:
+    async def verify(
+        self, pub: bytes, msg: bytes, tag: bytes, engine, device=True
+    ) -> bool:
         digest = hashlib.sha256(msg).digest()
         if engine is not None:
-            return await engine.verify_ed25519(pub, digest, tag)
+            if device:
+                return await engine.verify_ed25519(pub, digest, tag)
+            return await engine.verify_ed25519_host(pub, digest, tag)
         return hc.ed25519_verify(pub, digest, tag)
 
 
@@ -140,19 +152,23 @@ class SampleAuthenticator(api.Authenticator):
     async def verify_message_authen_tag(
         self, role: api.AuthenticationRole, peer_id: int, msg: bytes, tag: bytes
     ) -> None:
-        sig_engine = self._engine if self._batch_signatures else None
+        # Signature placement: TPU batches when batch_signatures is on;
+        # otherwise the engine's host queue (dedup without device round
+        # trips) when an engine exists; plain inline verification when not.
+        sig_engine = self._engine
+        sig_device = self._batch_signatures
         if role == api.AuthenticationRole.CLIENT:
             pub = self._client_pubs.get(peer_id)
             if pub is None:
                 raise api.AuthenticationError(f"unknown client {peer_id}")
-            if not await self._scheme.verify(pub, msg, tag, sig_engine):
+            if not await self._scheme.verify(pub, msg, tag, sig_engine, sig_device):
                 raise api.AuthenticationError("bad client signature")
             return
         if role == api.AuthenticationRole.REPLICA:
             pub = self._replica_pubs.get(peer_id)
             if pub is None:
                 raise api.AuthenticationError(f"unknown replica {peer_id}")
-            if not await self._scheme.verify(pub, msg, tag, sig_engine):
+            if not await self._scheme.verify(pub, msg, tag, sig_engine, sig_device):
                 raise api.AuthenticationError("bad replica signature")
             return
         if role == api.AuthenticationRole.USIG:

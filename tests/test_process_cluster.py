@@ -17,7 +17,7 @@ import time
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _wait_ports(ports, timeout=60.0):
+def _wait_ports(ports, timeout=120.0):
     deadline = time.time() + timeout
     pending = set(ports)
     while pending and time.time() < deadline:
@@ -91,8 +91,8 @@ def test_three_process_cluster_commits(tmp_path):
         req = subprocess.run(
             [sys.executable, "-m", "minbft_tpu.sample.peer",
              "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
-             "request", "process-cluster-op", "--timeout", "60"],
-            env=env, capture_output=True, text=True, timeout=120,
+             "request", "process-cluster-op", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
         )
         assert req.returncode == 0, req.stderr
         assert len(req.stdout.strip()) == 64  # hex block digest
@@ -103,8 +103,8 @@ def test_three_process_cluster_commits(tmp_path):
         req2 = subprocess.run(
             [sys.executable, "-m", "minbft_tpu.sample.peer",
              "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
-             "request", "after-backup-kill", "--timeout", "60"],
-            env=env, capture_output=True, text=True, timeout=120,
+             "request", "after-backup-kill", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
         )
         assert req2.returncode == 0, req2.stderr
     finally:
