@@ -350,6 +350,7 @@ def _batch_inv(vals: list, mod: int) -> list:
 def sign_batch(
     items: Sequence[Tuple[int, bytes]],
     bucket: int = 0,
+    kg_kernel=None,
 ) -> list:
     """[(private scalar d, digest32)] -> [(r, s)] — RFC 6979 deterministic,
     byte-identical to :func:`minbft_tpu.utils.hostcrypto.ecdsa_sign_py`.
@@ -357,7 +358,10 @@ def sign_batch(
     ``bucket`` pads the device batch to a fixed size (pad lanes compute
     1*G and are discarded) so varying batch sizes share one compiled
     kernel — hot-path callers must pass their bucket ladder's size, like
-    the verify path's engine buckets."""
+    the verify path's engine buckets.  ``kg_kernel`` overrides the k*G
+    kernel — pass :func:`minbft_tpu.parallel.mesh.sharded_ecdsa_sign_kernel`'s
+    result to shard signing across a device mesh (bucket must then be a
+    multiple of the mesh size)."""
     from ..utils import hostcrypto as hc
 
     b = len(items)
@@ -371,7 +375,8 @@ def sign_batch(
         k_arr[i] = to_limbs(k)
     if pad:
         k_arr[b:, 0] = 1  # k = 1: a valid lane, result discarded
-    xz = np.asarray(ecdsa_kg_kernel(jnp.asarray(k_arr))).astype("<u2")
+    kernel = kg_kernel if kg_kernel is not None else ecdsa_kg_kernel
+    xz = np.asarray(kernel(jnp.asarray(k_arr))).astype("<u2")
     xz = xz[:b]  # [B,2,16]
     # Vectorized limb→int: uint16 rows → little-endian bytes → one
     # int.from_bytes per row (a per-limb shift-sum costs ~250us/row).

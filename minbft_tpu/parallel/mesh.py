@@ -44,10 +44,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def sharded_verifier(scalar_verify: Callable, mesh: Mesh, n_args: int):
-    """vmap a scalar-shaped verifier and jit it with the batch axis sharded
+    """vmap a scalar-shaped kernel and jit it with the batch axis sharded
     over ``mesh``.
 
-    ``scalar_verify``: per-item verifier (limb/word arrays in, bool out).
+    ``scalar_verify``: per-item kernel (limb/word arrays in; any output
+    whose leading axis is the batch — bools for the verifiers, limb
+    arrays for the sign kernel; trailing dims are replicated).
     ``n_args``: number of positional array arguments (all batch-leading).
 
     The result expects every argument's leading dimension to be a multiple
@@ -95,3 +97,12 @@ def sharded_hmac_kernel(mesh: Mesh):
     from ..ops.hmac_sha256 import hmac32_verify
 
     return sharded_verifier(hmac32_verify, mesh, 3)
+
+
+def sharded_ecdsa_sign_kernel(mesh: Mesh):
+    """Batched fixed-base k*G (the device half of ECDSA signing,
+    :func:`minbft_tpu.ops.p256.sign_batch`) sharded across ``mesh``:
+    takes [B, 16] nonce limbs, returns [B, 2, 16] X/Z limbs."""
+    from ..ops import p256
+
+    return sharded_verifier(p256._kg_one, mesh, 1)

@@ -91,3 +91,24 @@ def test_sharded_output_matches_host(mesh8, ecdsa_kernel):
     args = tuple(jnp.asarray(a) for a in p256.prepare_batch(items))
     out = np.asarray(ecdsa_kernel(*args))
     assert out.tolist() == expected
+
+
+def test_sharded_sign_kernel(mesh8):
+    """Sharded fixed-base k*G agrees with the host scalar multiplication."""
+    from minbft_tpu.ops.limbs import from_limbs, to_limbs
+    from minbft_tpu.parallel.mesh import sharded_ecdsa_sign_kernel
+
+    kernel = sharded_ecdsa_sign_kernel(mesh8)
+    batch = 16
+    rng = np.random.default_rng(11)
+    ks = [int(rng.integers(1, 2**62)) for _ in range(batch)]
+    k_arr = np.stack([to_limbs(k) for k in ks]).astype(np.uint32)
+    xz = np.asarray(kernel(jnp.asarray(k_arr)))  # [B, 2, 16]
+
+    r_inv = pow(1 << 256, -1, hc.P)
+    for i, k in enumerate(ks):
+        xm, zm = from_limbs(xz[i, 0]), from_limbs(xz[i, 1])
+        assert zm != 0
+        xj, zj = xm * r_inv % hc.P, zm * r_inv % hc.P
+        x_aff = xj * pow(zj * zj % hc.P, -1, hc.P) % hc.P
+        assert x_aff == hc.scalar_mult(k, (hc.GX, hc.GY))[0]
