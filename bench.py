@@ -200,16 +200,28 @@ async def _bench_cluster(
     # ``batch_signatures`` stays available for hosts with PCIe-attached
     # chips.  Exception: the Ed25519 config exists to exercise the batched
     # Ed25519 signature kernel, so it opts in.
-    batch_sigs = scheme == "ed25519" and jax.default_backend() != "cpu"
-    replica_auths, client_auths = new_test_authenticators(
-        n,
-        n_clients=n_clients,
-        scheme=scheme,
-        usig_kind=usig_kind,
-        engines=engines,
-        batch_signatures=batch_sigs,
-        client_engine=shared if batch_sigs else None,
-    )
+    if scheme == "mac":
+        # Pairwise-MAC authentication (the reference's roadmap item; see
+        # sample/authentication/mac.py) — no public-key crypto on the
+        # request path at all.
+        from minbft_tpu.sample.authentication.mac import (
+            new_test_mac_authenticators,
+        )
+
+        replica_auths, client_auths = new_test_mac_authenticators(
+            n, n_clients=n_clients, usig_kind=usig_kind, engines=engines
+        )
+    else:
+        batch_sigs = scheme == "ed25519" and jax.default_backend() != "cpu"
+        replica_auths, client_auths = new_test_authenticators(
+            n,
+            n_clients=n_clients,
+            scheme=scheme,
+            usig_kind=usig_kind,
+            engines=engines,
+            batch_signatures=batch_sigs,
+            client_engine=shared if batch_sigs else None,
+        )
     stubs = make_testnet_stubs(n)
     ledgers = [SimpleLedger() for _ in range(n)]
     replicas = []
@@ -346,9 +358,20 @@ def main() -> None:
         # down by default (env-overridable) to keep the bench inside its
         # window; each reports committed req/s, which is rate-like and
         # meaningful at any duration.
+        cfg1_req = int(os.environ.get("MINBFT_BENCH_CFG1_REQUESTS", "1000"))
         cfg2_req = int(os.environ.get("MINBFT_BENCH_CFG2_REQUESTS", "1000"))
         cfg4_req = int(os.environ.get("MINBFT_BENCH_CFG4_REQUESTS", "2000"))
         cfg5_req = int(os.environ.get("MINBFT_BENCH_CFG5_REQUESTS", "1000"))
+        # config 1: n=4/f=1, SGX-less HMAC-SHA256 USIG, 1k no-op requests
+        # (the table's CPU-baseline row, run on whatever backend is live).
+        extras.update(
+            asyncio.run(
+                _bench_cluster(
+                    4, 1, cfg1_req, n_clients=min(n_clients, 50),
+                    usig_kind="hmac", prefix="cfg1",
+                )
+            )
+        )
         # config 2: n=4/f=1, ECDSA-P256 authenticator; USIG UIs batch on
         # the ECDSA kernel, REQUEST/REPLY signatures on host (the measured
         # placement — see _bench_cluster).  Shares the 512-bucket with
@@ -369,6 +392,20 @@ def main() -> None:
                 _bench_cluster(
                     13, 6, cfg4_req, n_clients=min(n_clients, 50),
                     usig_kind="hmac", max_batch=128, prefix="cfg4",
+                )
+            )
+        )
+        # Extra (beyond the BASELINE table): n=7/f=3 under the pairwise-MAC
+        # authentication scheme — the reference's roadmap item, and the
+        # fastest end-to-end configuration (no public-key crypto on the
+        # request path).
+        extras.update(
+            asyncio.run(
+                _bench_cluster(
+                    7, 3,
+                    int(os.environ.get("MINBFT_BENCH_MAC_REQUESTS", "4000")),
+                    n_clients=n_clients, usig_kind="hmac", scheme="mac",
+                    prefix="mac",
                 )
             )
         )

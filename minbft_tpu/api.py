@@ -46,9 +46,14 @@ class Authenticator(abc.ABC):
 
     @abc.abstractmethod
     def generate_message_authen_tag(
-        self, role: AuthenticationRole, msg: bytes
+        self, role: AuthenticationRole, msg: bytes, audience: int = -1
     ) -> bytes:
-        """Sign/certify ``msg`` under own key for ``role`` -> tag bytes."""
+        """Sign/certify ``msg`` under own key for ``role`` -> tag bytes.
+
+        ``audience``: the recipient principal id when the tag is
+        recipient-specific (a MAC-scheme REPLY is keyed to one client);
+        -1 = everyone (signatures, MAC vectors over all replicas).
+        Signature-scheme implementations ignore it."""
 
     @abc.abstractmethod
     async def verify_message_authen_tag(

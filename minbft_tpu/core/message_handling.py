@@ -148,8 +148,11 @@ class Handlers:
 
         # --- signing / verification primitives
         def sign_message(msg) -> None:
+            # A REPLY is addressed to one client: recipient-specific
+            # schemes (MAC) key the tag to it; signature schemes ignore it.
+            audience = msg.client_id if isinstance(msg, Reply) else -1
             msg.signature = authenticator.generate_message_authen_tag(
-                utils.signing_role(msg), authen_bytes(msg)
+                utils.signing_role(msg), authen_bytes(msg), audience
             )
 
         async def verify_signature(msg) -> None:

@@ -257,6 +257,12 @@ class BatchVerifier:
         q = self._queue("hmac_sha256", self._dispatch_hmac)
         return await q.submit((key, msg32, mac))
 
+    async def verify_hmac_sha256_host(
+        self, key: bytes, msg32: bytes, mac: bytes
+    ) -> bool:
+        q = self._queue("hmac_sha256_host", self._dispatch_hmac_host)
+        return await q.submit((key, msg32, mac))
+
     async def verify_ed25519(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
         q = self._queue("ed25519", self._dispatch_ed25519)
         return await q.submit((pub, msg, sig))
@@ -316,6 +322,20 @@ class BatchVerifier:
 
         return np.array(
             [hc.ecdsa_verify(q, digest, sig) for q, digest, sig in items],
+            dtype=bool,
+        )
+
+    def _dispatch_hmac_host(self, items) -> np.ndarray:
+        import hashlib
+        import hmac as hmac_mod
+
+        return np.array(
+            [
+                hmac_mod.compare_digest(
+                    hmac_mod.new(key, msg, hashlib.sha256).digest(), mac
+                )
+                for key, msg, mac in items
+            ],
             dtype=bool,
         )
 

@@ -131,7 +131,7 @@ class SampleAuthenticator(api.Authenticator):
     # -- generation ---------------------------------------------------------
 
     def generate_message_authen_tag(
-        self, role: api.AuthenticationRole, msg: bytes
+        self, role: api.AuthenticationRole, msg: bytes, audience: int = -1
     ) -> bytes:
         if role == api.AuthenticationRole.CLIENT:
             if self._client_priv is None:

@@ -1,0 +1,175 @@
+"""MAC-vector authentication (the reference's roadmap item,
+README.md:500-505): tag formats, slot verification, forgery rejection,
+and a full cluster commit under the MAC scheme."""
+
+import asyncio
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.sample.authentication.mac import (
+    MacAuthenticator,
+    generate_testnet_mac_keys,
+    new_test_mac_authenticators,
+)
+
+
+def test_request_vector_and_slots():
+    async def run():
+        n, n_clients = 4, 2
+        r_auths, c_auths = new_test_mac_authenticators(n, n_clients)
+        tag = c_auths[1].generate_message_authen_tag(
+            api.AuthenticationRole.CLIENT, b"req"
+        )
+        assert len(tag) == n * 32
+        # every replica accepts its slot
+        for r in range(n):
+            await r_auths[r].verify_message_authen_tag(
+                api.AuthenticationRole.CLIENT, 1, b"req", tag
+            )
+        # corrupt replica 2's slot: only replica 2 rejects
+        bad = tag[: 2 * 32] + bytes([tag[2 * 32] ^ 1]) + tag[2 * 32 + 1 :]
+        await r_auths[1].verify_message_authen_tag(
+            api.AuthenticationRole.CLIENT, 1, b"req", bad
+        )
+        with pytest.raises(api.AuthenticationError):
+            await r_auths[2].verify_message_authen_tag(
+                api.AuthenticationRole.CLIENT, 1, b"req", bad
+            )
+
+    asyncio.run(run())
+
+
+def test_reply_mac_is_recipient_specific():
+    async def run():
+        n = 3
+        r_auths, c_auths = new_test_mac_authenticators(n, 2)
+        tag = r_auths[2].generate_message_authen_tag(
+            api.AuthenticationRole.REPLICA, b"reply", audience=0
+        )
+        assert len(tag) == 32
+        await c_auths[0].verify_message_authen_tag(
+            api.AuthenticationRole.REPLICA, 2, b"reply", tag
+        )
+        # the other client's key rejects it
+        with pytest.raises(api.AuthenticationError):
+            await c_auths[1].verify_message_authen_tag(
+                api.AuthenticationRole.REPLICA, 2, b"reply", tag
+            )
+
+    asyncio.run(run())
+
+
+def test_replica_vector_for_view_change():
+    async def run():
+        n = 4
+        r_auths, _ = new_test_mac_authenticators(n, 1)
+        tag = r_auths[1].generate_message_authen_tag(
+            api.AuthenticationRole.REPLICA, b"rvc"
+        )
+        assert len(tag) == n * 32
+        for r in (0, 2, 3):
+            await r_auths[r].verify_message_authen_tag(
+                api.AuthenticationRole.REPLICA, 1, b"rvc", tag
+            )
+
+    asyncio.run(run())
+
+
+def test_key_views_are_minimal():
+    keys = generate_testnet_mac_keys(3, 2)
+    view = keys.view_for_replica(1)
+    assert all(k[1] == 1 for k in view.client_replica)
+    assert all(1 in k for k in view.replica_pair)
+    cview = keys.view_for_client(0)
+    assert all(k[0] == 0 for k in cview.client_replica)
+    assert not cview.replica_pair
+
+
+def test_cluster_commit_under_mac_scheme():
+    """Full n=4 commit where REQUEST/REPLY authentication is MACs and the
+    USIG path is unchanged."""
+
+    async def run():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.core import new_replica
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import (
+            InProcessClientConnector,
+            InProcessPeerConnector,
+            make_testnet_stubs,
+        )
+        from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+        n, f = 4, 1
+        cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+        r_auths, c_auths = new_test_mac_authenticators(n, 1, usig_kind="hmac")
+        stubs = make_testnet_stubs(n)
+        ledgers = [SimpleLedger() for _ in range(n)]
+        replicas = []
+        for i in range(n):
+            r = new_replica(
+                i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
+            )
+            stubs[i].assign_replica(r)
+            replicas.append(r)
+        for r in replicas:
+            await r.start()
+        client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        assert await asyncio.wait_for(client.request(b"mac-op"), 60)
+        for _ in range(200):
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        assert all(lg.length == 1 for lg in ledgers)
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_mac_verification_through_engine_queues():
+    """The engine-backed MAC paths: the host queue (default placement) and
+    the device HMAC kernel (device_macs=True) both accept valid slots and
+    reject corrupted ones."""
+
+    async def run():
+        from minbft_tpu.parallel import BatchVerifier
+
+        for device_macs in (False, True):
+            engine = BatchVerifier(max_batch=8, buckets=(8,))
+            r_auths, c_auths = new_test_mac_authenticators(
+                4, 1, engines=[engine] * 4, device_macs=device_macs,
+                client_engine=engine,
+            )
+            tag = c_auths[0].generate_message_authen_tag(
+                api.AuthenticationRole.CLIENT, b"via-engine"
+            )
+            await r_auths[1].verify_message_authen_tag(
+                api.AuthenticationRole.CLIENT, 0, b"via-engine", tag
+            )
+            bad = bytes([tag[32] ^ 1]) + tag[1:]
+            with pytest.raises(api.AuthenticationError):
+                await r_auths[0].verify_message_authen_tag(
+                    api.AuthenticationRole.CLIENT, 0, b"via-engine", bad
+                )
+            queue = "hmac_sha256" if device_macs else "hmac_sha256_host"
+            assert engine.stats[queue].items >= 2
+
+    asyncio.run(run())
+
+
+def test_unknown_principal_raises_auth_error():
+    async def run():
+        r_auths, c_auths = new_test_mac_authenticators(3, 1)
+        tag = c_auths[0].generate_message_authen_tag(
+            api.AuthenticationRole.CLIENT, b"m"
+        )
+        with pytest.raises(api.AuthenticationError):
+            await r_auths[0].verify_message_authen_tag(
+                api.AuthenticationRole.CLIENT, 9999, b"m", tag
+            )
+
+    asyncio.run(run())
