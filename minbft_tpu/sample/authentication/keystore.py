@@ -152,6 +152,8 @@ class KeyStore:
         self.client_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
         # {id: (sealed bytes|None, usig_id bytes)}
         self.usig_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
+        # optional pairwise-MAC material (sample/authentication/mac.py)
+        self.mac_keys = None  # Optional[MacKeys]
 
     # -- serialization -------------------------------------------------------
 
@@ -173,9 +175,23 @@ class KeyStore:
                 ],
             }
 
+        mac_section = {}
+        if self.mac_keys is not None:
+            mac_section["macs"] = {
+                "keyspec": "HMAC_PAIRWISE",
+                "clientReplica": [
+                    {"client": c, "replica": r, "key": base64.b64encode(k).decode()}
+                    for (c, r), k in sorted(self.mac_keys.client_replica.items())
+                ],
+                "replicaPair": [
+                    {"i": i, "j": j, "key": base64.b64encode(k).decode()}
+                    for (i, j), k in sorted(self.mac_keys.replica_pair.items())
+                ],
+            }
         return {
             "replica": sig_section(self.replica_keys),
             "client": sig_section(self.client_keys),
+            **mac_section,
             "usig": {
                 "keyspec": self.usig_spec,
                 "keys": [
@@ -221,6 +237,23 @@ class KeyStore:
 
         store.replica_keys = read_sig(rep)
         store.client_keys = read_sig(data.get("client", {}))
+        macs = data.get("macs")
+        if macs:
+            mac_spec = macs.get("keyspec", "HMAC_PAIRWISE")
+            if mac_spec != "HMAC_PAIRWISE":
+                raise KeyStoreError(f"unknown MAC keyspec {mac_spec!r}")
+            from .mac import MacKeys
+
+            store.mac_keys = MacKeys(
+                {
+                    (int(e["client"]), int(e["replica"])): base64.b64decode(e["key"])
+                    for e in macs.get("clientReplica", [])
+                },
+                {
+                    (int(e["i"]), int(e["j"])): base64.b64decode(e["key"])
+                    for e in macs.get("replicaPair", [])
+                },
+            )
         for entry in usig.get("keys", []):
             sealed = entry.get("sealedKey")
             store.usig_keys[int(entry["id"])] = (
@@ -245,7 +278,8 @@ class KeyStore:
 
     def strip_private(self, keep_replica: Optional[int] = None) -> "KeyStore":
         """A copy safe to hand to other nodes: private material removed
-        except (optionally) one replica's own keys."""
+        except (optionally) one replica's own keys (for MACs: its pairwise
+        rows only — MAC secrets are inherently shared per pair)."""
         out = KeyStore(scheme=self.scheme, usig_spec=self.usig_spec)
         out.replica_keys = {
             kid: (priv if kid == keep_replica else None, pub)
@@ -256,6 +290,8 @@ class KeyStore:
             kid: (sealed if kid == keep_replica else None, uid)
             for kid, (sealed, uid) in self.usig_keys.items()
         }
+        if self.mac_keys is not None and keep_replica is not None:
+            out.mac_keys = self.mac_keys.view_for_replica(keep_replica)
         return out
 
     # -- restoration ---------------------------------------------------------
@@ -304,6 +340,39 @@ class KeyStore:
             batch_signatures=batch_signatures,
         )
 
+    def mac_replica_authenticator(
+        self, replica_id: int, engine=None, device_macs: bool = False
+    ):
+        """MAC-scheme authenticator for a replica (requires a ``macs``
+        section; USIG delegates to this store's sealed USIG)."""
+        if self.mac_keys is None:
+            raise KeyStoreError("keystore has no MAC section")
+        from .mac import MacAuthenticator
+
+        n = len(self.usig_keys)
+        inner = SampleAuthenticator(
+            usig=self.make_usig(replica_id),
+            usig_ids=self.usig_ids(),
+            engine=engine,
+            batch_signatures=False,
+        )
+        # The principal's view only — handing out the full matrix would let
+        # one compromised replica forge other principals' MAC slots.
+        return MacAuthenticator(
+            replica_id, False, n, self.mac_keys.view_for_replica(replica_id),
+            inner=inner, engine=engine, device_macs=device_macs,
+        )
+
+    def mac_client_authenticator(self, client_id: int, engine=None):
+        if self.mac_keys is None:
+            raise KeyStoreError("keystore has no MAC section")
+        from .mac import MacAuthenticator
+
+        return MacAuthenticator(
+            client_id, True, len(self.usig_keys),
+            self.mac_keys.view_for_client(client_id), engine=engine,
+        )
+
     def client_authenticator(self, client_id: int, engine=None) -> SampleAuthenticator:
         priv, _ = self._decode_sig(self.client_keys, client_id)
         if priv is None:
@@ -322,6 +391,7 @@ def generate_testnet_keys(
     n_clients: int = 1,
     scheme: str = "ecdsa-p256",
     usig_spec: str = "auto",
+    with_macs: bool = False,
 ) -> KeyStore:
     """Generate a full testnet keystore (reference GenerateTestnetKeys,
     keymanager.go:404-450): n replica keypairs + USIGs, n_clients client
@@ -342,4 +412,8 @@ def generate_testnet_keys(
     for i in range(n):
         u, sealed = _new_usig(usig_spec, shared_hmac_key=shared)
         store.usig_keys[i] = (sealed, u.id())
+    if with_macs:
+        from .mac import generate_testnet_mac_keys
+
+        store.mac_keys = generate_testnet_mac_keys(n, n_clients)
     return store

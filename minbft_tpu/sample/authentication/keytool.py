@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=_env_default("usig", "auto", choices=_USIG_SPECS),
         help="USIG keyspec (auto = native module if buildable, else soft)",
     )
+    g.add_argument(
+        "--macs",
+        action="store_true",
+        default=bool(_env_default("macs", 0)),
+        help="also generate pairwise-MAC material (MAC authentication scheme)",
+    )
     return p
 
 
@@ -78,12 +84,14 @@ def main(argv=None) -> int:
             n_clients=args.clients,
             scheme=args.scheme,
             usig_spec=args.usig,
+            with_macs=args.macs,
         )
         store.save(args.output)
         print(
             f"wrote {args.output}: {args.replicas} replicas, "
             f"{args.clients} clients, scheme={store.scheme}, "
-            f"usig={store.usig_spec}",
+            f"usig={store.usig_spec}"
+            + (", pairwise MACs" if store.mac_keys is not None else ""),
             file=sys.stderr,
         )
         return 0

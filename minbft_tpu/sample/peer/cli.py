@@ -48,6 +48,14 @@ def build_parser() -> argparse.ArgumentParser:
         choices=_levels,
     )
     p.add_argument("--log-file", default=_env("log_file", "") or None)
+    _auths = ("signatures", "mac")
+    p.add_argument(
+        "--auth",
+        choices=_auths,
+        default=_env("auth", "signatures", choices=_auths),
+        help="message authentication: public-key signatures (default) or "
+        "pairwise MACs (keys.yaml needs a macs section: keytool --macs)",
+    )
     sub = p.add_subparsers(dest="command", required=True)
 
     r = sub.add_parser("run", help="run a replica")
@@ -90,6 +98,10 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256"),
         default="auto",
     )
+    t.add_argument(
+        "--macs", action="store_true",
+        help="include pairwise-MAC material (enables run/request --auth mac)",
+    )
     return p
 
 
@@ -131,9 +143,12 @@ async def _run_replica(args) -> int:
             engine = BatchVerifier(max_batch=args.batch, buckets=(args.batch,))
             batch_signatures = True
 
-    auth = store.replica_authenticator(
-        args.id, engine=engine, batch_signatures=batch_signatures
-    )
+    if args.auth == "mac":
+        auth = store.mac_replica_authenticator(args.id, engine=engine)
+    else:
+        auth = store.replica_authenticator(
+            args.id, engine=engine, batch_signatures=batch_signatures
+        )
     conn = GrpcReplicaConnector("peer")
     for rid, addr in addrs.items():
         if rid != args.id:
@@ -180,9 +195,11 @@ async def _run_request(args) -> int:
         ops = [line.rstrip("\n").encode() for line in sys.stdin if line.strip()]
 
     conn = connect_many_replicas(addrs, kind="client")
-    client = new_client(
-        args.client_id, cfg.n, cfg.f, store.client_authenticator(args.client_id), conn
-    )
+    if args.auth == "mac":
+        client_auth = store.mac_client_authenticator(args.client_id)
+    else:
+        client_auth = store.client_authenticator(args.client_id)
+    client = new_client(args.client_id, cfg.n, cfg.f, client_auth, conn)
     await client.start()
     rc = 0
     try:
@@ -263,7 +280,8 @@ def _run_testnet_scaffold(args) -> int:
         raise SystemExit(f"peer: n={args.replicas} < 2f+1 with f={f}")
     os.makedirs(args.dir, exist_ok=True)
     store = generate_testnet_keys(
-        args.replicas, n_clients=args.clients, usig_spec=args.usig
+        args.replicas, n_clients=args.clients, usig_spec=args.usig,
+        with_macs=args.macs,
     )
     keys_path = os.path.join(args.dir, "keys.yaml")
     store.save(keys_path)

@@ -110,3 +110,39 @@ def test_keytool_generate(tmp_path):
     assert len(store.replica_keys) == 4
     assert len(store.client_keys) == 2
     assert len(store.usig_keys) == 4
+
+
+def test_mac_section_roundtrip_and_cluster(tmp_path):
+    """MAC pairwise material persists in keys.yaml and restores working
+    MAC authenticators (cross sign/verify + a cluster commit)."""
+    import asyncio
+
+    store = _roundtrip(
+        tmp_path,
+        generate_testnet_keys(3, n_clients=2, usig_spec="SOFT_ECDSA", with_macs=True),
+    )
+    assert store.mac_keys is not None
+
+    async def run():
+        r_auths = [store.mac_replica_authenticator(i) for i in range(3)]
+        c_auth = store.mac_client_authenticator(1)
+        tag = c_auth.generate_message_authen_tag(api.AuthenticationRole.CLIENT, b"m")
+        for r in range(3):
+            await r_auths[r].verify_message_authen_tag(
+                api.AuthenticationRole.CLIENT, 1, b"m", tag
+            )
+        # USIG path still works through the restored sealed key
+        utag = r_auths[0].generate_message_authen_tag(
+            api.AuthenticationRole.USIG, b"u"
+        )
+        await r_auths[1].verify_message_authen_tag(
+            api.AuthenticationRole.USIG, 0, b"u", utag
+        )
+
+    asyncio.run(run())
+
+    # stripping keeps only the kept replica's MAC rows
+    stripped = store.strip_private(keep_replica=2)
+    assert stripped.mac_keys is not None
+    assert all(k[1] == 2 for k in stripped.mac_keys.client_replica)
+    assert all(2 in k for k in stripped.mac_keys.replica_pair)
