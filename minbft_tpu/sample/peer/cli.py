@@ -76,6 +76,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="serial host-crypto verification (no TPU engine)",
     )
+    r.add_argument(
+        "--metrics-interval",
+        type=float,
+        default=_env("metrics_interval", 0.0),
+        help="log the protocol counters every N seconds (0 = off)",
+    )
 
     q = sub.add_parser("request", help="submit request(s) as a client")
     q.add_argument("ops", nargs="*", help="operations (default: stdin lines)")
@@ -100,6 +106,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument(
         "--macs", action="store_true",
+        default=bool(_env("macs", 0)),
         help="include pairwise-MAC material (enables run/request --auth mac)",
     )
     return p
@@ -144,7 +151,11 @@ async def _run_replica(args) -> int:
             batch_signatures = True
 
     if args.auth == "mac":
-        auth = store.mac_replica_authenticator(args.id, engine=engine)
+        # device_macs follows the signature-placement rule: the HMAC batch
+        # kernel only beats host HMAC where the chip isn't remote-attached.
+        auth = store.mac_replica_authenticator(
+            args.id, engine=engine, device_macs=batch_signatures
+        )
     else:
         auth = store.replica_authenticator(
             args.id, engine=engine, batch_signatures=batch_signatures
@@ -170,7 +181,22 @@ async def _run_replica(args) -> int:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # non-Unix
             pass
+
+    async def log_metrics() -> None:
+        import json as _json
+
+        while not stop.is_set():
+            await asyncio.sleep(args.metrics_interval)
+            snap = replica.metrics.snapshot()
+            snap["executed_per_sec"] = round(replica.metrics.executed_per_sec(), 2)
+            print(f"metrics: {_json.dumps(snap)}", file=sys.stderr)
+
+    metrics_task = (
+        loop.create_task(log_metrics()) if args.metrics_interval > 0 else None
+    )
     await stop.wait()
+    if metrics_task is not None:
+        metrics_task.cancel()
     print(f"replica {args.id} shutting down", file=sys.stderr)
     await replica.stop()
     await server.stop()

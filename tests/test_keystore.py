@@ -114,7 +114,9 @@ def test_keytool_generate(tmp_path):
 
 def test_mac_section_roundtrip_and_cluster(tmp_path):
     """MAC pairwise material persists in keys.yaml and restores working
-    MAC authenticators (cross sign/verify + a cluster commit)."""
+    MAC authenticators (cross sign/verify of every role; the full cluster
+    commit under MAC auth lives in tests/test_mac_auth.py and the CLI
+    socket flow was driven via peer --auth mac)."""
     import asyncio
 
     store = _roundtrip(
