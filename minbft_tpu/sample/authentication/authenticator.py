@@ -232,6 +232,20 @@ class SampleAuthenticator(api.Authenticator):
             raise api.AuthenticationError(str(e)) from e
 
 
+def make_testnet_usigs(n: int, usig_kind: str):
+    """Testnet USIG instances + trust anchors, shared by the signature and
+    MAC authenticator factories (one source of truth for the shared HMAC
+    testnet key)."""
+    if usig_kind == "ecdsa":
+        usigs = [EcdsaUSIG() for _ in range(n)]
+    elif usig_kind == "hmac":
+        shared = hashlib.sha256(b"testnet-usig-key").digest()
+        usigs = [HmacUSIG(shared) for _ in range(n)]
+    else:
+        raise ValueError(usig_kind)
+    return usigs, {i: u.id() for i, u in enumerate(usigs)}
+
+
 def new_test_authenticators(
     n: int,
     n_clients: int = 1,
@@ -260,14 +274,7 @@ def new_test_authenticators(
     else:
         raise ValueError(scheme)
 
-    if usig_kind == "ecdsa":
-        usigs = [EcdsaUSIG() for _ in range(n)]
-    elif usig_kind == "hmac":
-        shared = hashlib.sha256(b"testnet-usig-key").digest()
-        usigs = [HmacUSIG(shared) for _ in range(n)]
-    else:
-        raise ValueError(usig_kind)
-    usig_ids = {i: u.id() for i, u in enumerate(usigs)}
+    usigs, usig_ids = make_testnet_usigs(n, usig_kind)
 
     replica_auths = [
         SampleAuthenticator(

@@ -224,20 +224,11 @@ def new_test_mac_authenticators(
 ):
     """Testnet MAC authenticators (mirrors new_test_authenticators):
     returns (replica_auths, client_auths)."""
-    import hashlib as _hashlib
-
-    from ...usig.software import EcdsaUSIG, HmacUSIG
+    from .authenticator import make_testnet_usigs
 
     # Inner authenticators carry only the USIG role (MACs replace the
     # signature roles, so no signature keypairs are generated).
-    if usig_kind == "ecdsa":
-        usigs = [EcdsaUSIG() for _ in range(n)]
-    elif usig_kind == "hmac":
-        shared_key = _hashlib.sha256(b"testnet-usig-key").digest()
-        usigs = [HmacUSIG(shared_key) for _ in range(n)]
-    else:
-        raise ValueError(usig_kind)
-    usig_ids = {i: u.id() for i, u in enumerate(usigs)}
+    usigs, usig_ids = make_testnet_usigs(n, usig_kind)
     inner_replicas = [
         SampleAuthenticator(
             usig=usigs[i],

@@ -6,8 +6,10 @@ serves (run.go:91-159); ``peer request <args…>`` is the client-side
 equivalent, reading operations from argv or stdin (request.go:87-134);
 flags layer over ``PEER_*`` environment variables (root.go:73-82).
 
-    python -m minbft_tpu.sample.peer run 0 --keys keys.yaml --config consensus.yaml
-    python -m minbft_tpu.sample.peer request --keys keys.yaml --config consensus.yaml "op"
+    # shared flags (--keys/--config/--auth/--log-level) go BEFORE the
+    # subcommand; per-subcommand flags (--listen/--batch/...) after it:
+    python -m minbft_tpu.sample.peer --keys keys.yaml --config consensus.yaml run 0
+    python -m minbft_tpu.sample.peer --keys keys.yaml --config consensus.yaml request "op"
     python -m minbft_tpu.sample.peer selftest   # in-process n=4 smoke test
 
 The replica's COMMIT-phase verification runs through the TPU batching

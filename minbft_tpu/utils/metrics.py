@@ -12,6 +12,7 @@ protocol layer above it.
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from typing import Dict, Optional
 
@@ -44,8 +45,9 @@ class LatencyReservoir:
         if not self._samples:
             return 0.0
         s = sorted(self._samples)
-        idx = min(len(s) - 1, int(q / 100.0 * len(s)))
-        return s[idx]
+        # nearest-rank: smallest value with at least q% of samples <= it
+        idx = max(0, math.ceil(q / 100.0 * len(s)) - 1)
+        return s[min(idx, len(s) - 1)]
 
 
 class ReplicaMetrics:

@@ -1,0 +1,65 @@
+"""Codec fuzzing: random and mutated wire bytes must either raise
+CodecError or decode to a message that re-marshals canonically — never
+crash with another exception, hang, or decode two distinct byte strings
+ambiguously.  (The reference relies on protobuf's hardening; this build's
+hand-rolled codec earns it here.)"""
+
+import random
+
+import pytest
+
+from minbft_tpu.messages import CodecError, marshal, unmarshal
+from minbft_tpu.messages.message import UI, Commit, Hello, Prepare, Reply, Request
+
+
+def _sample_messages():
+    req = Request(client_id=3, seq=9, operation=b"op-bytes", signature=b"sig")
+    prep = Prepare(
+        replica_id=0, view=0, requests=[req], ui=UI(counter=5, cert=b"cert")
+    )
+    return [
+        Hello(replica_id=2),
+        req,
+        Reply(replica_id=1, client_id=3, seq=9, result=b"res", signature=b"s2"),
+        prep,
+        Commit(replica_id=4, prepare=prep, ui=UI(counter=6, cert=b"c2")),
+    ]
+
+
+def test_random_bytes_never_crash():
+    rng = random.Random(1234)
+    for _ in range(3000):
+        data = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 80)))
+        try:
+            m = unmarshal(data)
+        except CodecError:
+            continue
+        # decoded: must re-marshal canonically
+        assert marshal(m) == data
+
+
+@pytest.mark.parametrize("mi", range(5))
+def test_mutated_wire_bytes_never_crash(mi):
+    rng = random.Random(99 + mi)
+    base = marshal(_sample_messages()[mi])
+    for _ in range(800):
+        data = bytearray(base)
+        op = rng.randrange(3)
+        if op == 0 and data:  # flip a byte
+            i = rng.randrange(len(data))
+            data[i] ^= rng.randrange(1, 256)
+        elif op == 1:  # truncate
+            data = data[: rng.randrange(len(data) + 1)]
+        else:  # extend with junk
+            data += bytes(rng.randrange(256) for _ in range(rng.randrange(1, 9)))
+        try:
+            m = unmarshal(bytes(data))
+        except CodecError:
+            continue
+        assert marshal(m) == bytes(data)
+
+
+def test_roundtrip_is_canonical_for_all_kinds():
+    for m in _sample_messages():
+        data = marshal(m)
+        assert marshal(unmarshal(data)) == data
