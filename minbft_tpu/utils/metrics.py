@@ -45,8 +45,10 @@ class LatencyReservoir:
         if not self._samples:
             return 0.0
         s = sorted(self._samples)
-        # nearest-rank: smallest value with at least q% of samples <= it
-        idx = max(0, math.ceil(q / 100.0 * len(s)) - 1)
+        # nearest-rank: smallest value with at least q% of samples <= it.
+        # Round away binary-float fuzz first (q=55, n=100 would otherwise
+        # compute ceil(55.000000000000014) = 56).
+        idx = max(0, math.ceil(round(q / 100.0 * len(s), 9)) - 1)
         return s[min(idx, len(s) - 1)]
 
 

@@ -23,6 +23,35 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+
+async def make_cluster(n=4, f=1, n_clients=1, usig_kind="hmac", **auth_kw):
+    """Start an in-process cluster (the reference integration-test layout,
+    core/integration_test.go:212-226).  Returns (replicas, client_auths,
+    stubs, ledgers); caller stops the replicas."""
+    from minbft_tpu.core import new_replica
+    from minbft_tpu.sample.authentication import new_test_authenticators
+    from minbft_tpu.sample.config import SimpleConfiger
+    from minbft_tpu.sample.conn.inprocess import (
+        InProcessPeerConnector,
+        make_testnet_stubs,
+    )
+    from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+    cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+    r_auths, c_auths = new_test_authenticators(
+        n, n_clients=n_clients, usig_kind=usig_kind, **auth_kw
+    )
+    stubs = make_testnet_stubs(n)
+    ledgers = [SimpleLedger() for _ in range(n)]
+    replicas = []
+    for i in range(n):
+        r = new_replica(i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i])
+        stubs[i].assign_replica(r)
+        replicas.append(r)
+    for r in replicas:
+        await r.start()
+    return replicas, c_auths, stubs, ledgers
+
 # Persistent compilation cache: the crypto kernels are compile-dominated on
 # the CPU backend (a cold ECDSA ladder compile is ~2 min), so warm CI runs
 # should pay zero compiles.  Keyed by HLO, so kernel changes re-compile

@@ -1,0 +1,143 @@
+"""Byzantine-fault integration tests: a cluster fed forged, malformed, and
+replayed peer messages must reject them (messages_dropped counts up) while
+staying live and consistent — the BFT property the unit-level rejection
+tests imply, demonstrated end-to-end.  (The reference demonstrates fault
+tolerance only by killing processes, README.md:411-458; crafted-message
+faults are this build's addition.)"""
+
+import asyncio
+
+from conftest import make_cluster
+from minbft_tpu.client import new_client
+from minbft_tpu.messages import Hello, UI, marshal
+from minbft_tpu.messages.message import Commit, Prepare, Request
+from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+
+
+async def _inject_peer_messages(stub, attacker_id: int, payloads) -> None:
+    """Open a peer stream to the stub's replica (as the reference's HELLO
+    handshake does) and pump crafted payloads into it."""
+    handler = stub.peer_message_stream_handler()
+    done = asyncio.Event()
+
+    async def outgoing():
+        yield marshal(Hello(replica_id=attacker_id))
+        for p in payloads:
+            yield p
+        # keep the stream open briefly so the payloads are consumed
+        try:
+            await asyncio.wait_for(done.wait(), 1.0)
+        except asyncio.TimeoutError:
+            return
+
+    consumed = asyncio.ensure_future(_drain(handler.handle_message_stream(outgoing())))
+    await asyncio.sleep(0.3)
+    done.set()
+    consumed.cancel()
+    try:
+        await consumed
+    except (asyncio.CancelledError, Exception):
+        pass
+
+
+async def _drain(aiter):
+    async for _ in aiter:
+        pass
+
+
+def test_cluster_survives_forged_and_malformed_peer_messages():
+    async def run():
+        replicas, c_auths, stubs, ledgers = await make_cluster()
+        client = new_client(0, 4, 1, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+
+        # a healthy commit first
+        assert await asyncio.wait_for(client.request(b"before-attack"), 30)
+
+        # craft garbage from "replica 2" aimed at replica 1:
+        fake_req = Request(client_id=0, seq=999, operation=b"evil", signature=b"x" * 64)
+        fake_prep = Prepare(
+            replica_id=0, view=0, requests=[fake_req],
+            ui=UI(counter=77, cert=b"\x01" * 40),
+        )
+        payloads = [
+            b"\xff\x00garbage-not-a-message",          # malformed wire bytes
+            marshal(fake_prep),                          # forged primary UI
+            marshal(
+                Commit(replica_id=2, prepare=fake_prep, ui=UI(counter=9, cert=b"z" * 40))
+            ),                                           # forged commit
+            marshal(fake_req),                           # forged client sig via peer stream
+        ]
+        dropped_before = replicas[1].metrics.counters.get("messages_dropped", 0)
+        await _inject_peer_messages(stubs[1], 2, payloads)
+
+        # give the drops a moment to be accounted
+        for _ in range(100):
+            if replicas[1].metrics.counters.get("messages_dropped", 0) >= dropped_before + 3:
+                break
+            await asyncio.sleep(0.02)
+        assert replicas[1].metrics.counters.get("messages_dropped", 0) >= dropped_before + 3
+
+        # the cluster is still live and consistent
+        assert await asyncio.wait_for(client.request(b"after-attack"), 30)
+        for _ in range(200):
+            if all(lg.length == 2 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        assert all(lg.length == 2 for lg in ledgers), [lg.length for lg in ledgers]
+        # no forged operation ever executed
+        for lg in ledgers:
+            ops = [lg.block(h).payload for h in range(1, lg.length + 1)]
+            assert all(b"evil" not in op for op in ops), ops
+
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_replayed_commit_is_idempotent():
+    """A replica re-delivering its COMMIT (network duplication) must not
+    double-execute (in-order once-only UI capture)."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await make_cluster()
+        client = new_client(0, 4, 1, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        assert await asyncio.wait_for(client.request(b"op"), 30)
+        for _ in range(100):
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+
+        # replay replica 2's genuine COMMIT at replica 1
+        commits = [
+            m for m in replicas[2].handlers.message_log.snapshot()
+            if isinstance(m, Commit)
+        ]
+        assert commits
+        handled_before = replicas[1].metrics.counters.get("messages_handled", 0)
+        await _inject_peer_messages(stubs[1], 2, [marshal(commits[0])] * 3)
+        # positive delivery signal: the replays were actually handled
+        # (validated, then deduplicated by in-order UI capture) — without
+        # this the test could pass vacuously if injection silently failed
+        for _ in range(100):
+            if (
+                replicas[1].metrics.counters.get("messages_handled", 0)
+                >= handled_before + 3
+            ):
+                break
+            await asyncio.sleep(0.02)
+        assert (
+            replicas[1].metrics.counters.get("messages_handled", 0)
+            >= handled_before + 3
+        )
+        await asyncio.sleep(0.2)
+        assert ledgers[1].length == 1  # no double execution
+
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())

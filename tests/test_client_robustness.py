@@ -8,15 +8,8 @@ import pytest
 
 from minbft_tpu import api
 from minbft_tpu.client import new_client
-from minbft_tpu.core import new_replica
-from minbft_tpu.sample.authentication import new_test_authenticators
-from minbft_tpu.sample.config import SimpleConfiger
-from minbft_tpu.sample.conn.inprocess import (
-    InProcessClientConnector,
-    InProcessPeerConnector,
-    make_testnet_stubs,
-)
-from minbft_tpu.sample.requestconsumer import SimpleLedger
+from conftest import make_cluster as _cluster
+from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
 
 
 class _LossyClientConnector(api.ReplicaConnector):
@@ -47,21 +40,6 @@ class _LossyClientConnector(api.ReplicaConnector):
                     yield out
 
         return _Lossy()
-
-
-async def _cluster(n=4, f=1):
-    cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
-    r_auths, c_auths = new_test_authenticators(n, n_clients=1, usig_kind="hmac")
-    stubs = make_testnet_stubs(n)
-    ledgers = [SimpleLedger() for _ in range(n)]
-    replicas = []
-    for i in range(n):
-        r = new_replica(i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i])
-        stubs[i].assign_replica(r)
-        replicas.append(r)
-    for r in replicas:
-        await r.start()
-    return replicas, c_auths, stubs, ledgers
 
 
 def test_retransmit_recovers_lost_request():
@@ -154,23 +132,8 @@ def test_ed25519_scheme_cluster_commit():
     scheme) on the SIM backend."""
 
     async def run():
-        n, f = 4, 1
-        cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
-        r_auths, c_auths = new_test_authenticators(
-            n, n_clients=1, scheme="ed25519", usig_kind="hmac"
-        )
-        stubs = make_testnet_stubs(n)
-        ledgers = [SimpleLedger() for _ in range(n)]
-        replicas = []
-        for i in range(n):
-            r = new_replica(
-                i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
-            )
-            stubs[i].assign_replica(r)
-            replicas.append(r)
-        for r in replicas:
-            await r.start()
-        client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
+        replicas, c_auths, stubs, ledgers = await _cluster(scheme="ed25519")
+        client = new_client(0, 4, 1, c_auths[0], InProcessClientConnector(stubs))
         await client.start()
         assert await asyncio.wait_for(client.request(b"ed-op"), 60)
         await client.stop()
