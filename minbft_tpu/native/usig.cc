@@ -15,7 +15,15 @@
 
 namespace {
 
-constexpr unsigned char kSealMagic[4] = {'U', 'S', 'G', '1'};
+/* Seal layout v2: magic(4) || der-private-key.  The epoch is NOT sealed:
+ * every init draws a fresh random epoch (reference usig.c:168-186 draws
+ * sgx_read_rand before unsealing), so a restored instance whose counter
+ * restarts at 1 can never re-certify (epoch, cv) pairs already issued by
+ * a previous instance of the same key. */
+constexpr unsigned char kSealMagic[4] = {'U', 'S', 'G', '2'};
+/* v1 blobs carried a sealed epoch (magic || epoch_be8 || key); accepted
+ * for key recovery, with the stored epoch ignored. */
+constexpr unsigned char kSealMagicV1[4] = {'U', 'S', 'G', '1'};
 
 /* DER ECDSA-Sig-Value -> raw r||s (32+32 big-endian).  The encoding is
  * SEQUENCE { INTEGER r, INTEGER s } with minimal-length integers. */
@@ -117,41 +125,43 @@ int usig_init(usig_t **out, const uint8_t *sealed, size_t sealed_len) {
   if (out == nullptr) return USIG_ERR_ARG;
   usig_t *u = new (std::nothrow) usig_t;
   if (u == nullptr) return USIG_ERR_ALLOC;
+  /* Fresh random epoch on EVERY init — including restores.  The counter
+   * restarts at 1, so reusing an old epoch would let a restarted instance
+   * certify different messages under already-issued (epoch, cv) values:
+   * exactly the equivocation USIG exists to prevent (reference
+   * usig.c:177-186).  Verifiers learn the new epoch trust-on-first-use
+   * (reference crypto.go:204-218; SampleAuthenticator epoch capture). */
+  unsigned char eb[8];
+  if (RAND_bytes(eb, 8) != 1) {
+    delete u;
+    return USIG_ERR_CRYPTO;
+  }
+  u->epoch = 0;
+  for (int i = 0; i < 8; ++i) u->epoch = (u->epoch << 8) | eb[i];
   if (sealed == nullptr) {
     u->key = EVP_PKEY_Q_keygen(nullptr, nullptr, "EC", "P-256");
     if (u->key == nullptr) {
       delete u;
       return USIG_ERR_CRYPTO;
     }
-    unsigned char eb[8];
-    if (RAND_bytes(eb, 8) != 1) {
-      EVP_PKEY_free(u->key);
-      delete u;
-      return USIG_ERR_CRYPTO;
-    }
-    u->epoch = 0;
-    for (int i = 0; i < 8; ++i) u->epoch = (u->epoch << 8) | eb[i];
   } else {
-    /* seal layout: magic(4) || epoch_be8 || der-private-key */
-    if (sealed_len < 12 || std::memcmp(sealed, kSealMagic, 4) != 0) {
+    size_t key_off;
+    if (sealed_len >= 5 && std::memcmp(sealed, kSealMagic, 4) == 0) {
+      key_off = 4;
+    } else if (sealed_len >= 13 &&
+               std::memcmp(sealed, kSealMagicV1, 4) == 0) {
+      key_off = 12; /* skip the v1 sealed epoch; it is never reused */
+    } else {
       delete u;
       return USIG_ERR_SEALED;
     }
-    u->epoch = 0;
-    for (int i = 0; i < 8; ++i) u->epoch = (u->epoch << 8) | sealed[4 + i];
-    const unsigned char *p = sealed + 12;
+    const unsigned char *p = sealed + key_off;
     u->key = d2i_AutoPrivateKey(nullptr, &p,
-                                static_cast<long>(sealed_len - 12));
+                                static_cast<long>(sealed_len - key_off));
     if (u->key == nullptr) {
       delete u;
       return USIG_ERR_SEALED;
     }
-    /* NOTE: like the reference, only the KEY and epoch are durable; the
-     * counter restarts from 1.  A restored instance must therefore use a
-     * fresh epoch in production deployments — callers get the sealed
-     * epoch back so trust anchors (usig IDs) remain stable, exactly the
-     * reference's unseal behavior (usig.c:140-166 restores the key; the
-     * counter is volatile enclave state). */
   }
   *out = u;
   return USIG_OK;
@@ -209,7 +219,7 @@ int usig_sealed_size(usig_t *u, size_t *out) {
   if (u == nullptr || out == nullptr) return USIG_ERR_ARG;
   int der_len = i2d_PrivateKey(u->key, nullptr);
   if (der_len <= 0) return USIG_ERR_CRYPTO;
-  *out = 12 + static_cast<size_t>(der_len);
+  *out = 4 + static_cast<size_t>(der_len);
   return USIG_OK;
 }
 
@@ -221,12 +231,10 @@ int usig_seal(usig_t *u, uint8_t *out, size_t cap, size_t *out_len) {
   if (rc != USIG_OK) return rc;
   if (cap < need) return USIG_ERR_BUFSZ;
   std::memcpy(out, kSealMagic, 4);
-  for (int i = 0; i < 8; ++i)
-    out[4 + i] = static_cast<unsigned char>(u->epoch >> (56 - 8 * i));
-  unsigned char *p = out + 12;
+  unsigned char *p = out + 4;
   int der_len = i2d_PrivateKey(u->key, &p);
   if (der_len <= 0) return USIG_ERR_CRYPTO;
-  *out_len = 12 + static_cast<size_t>(der_len);
+  *out_len = 4 + static_cast<size_t>(der_len);
   return USIG_OK;
 }
 

@@ -10,10 +10,13 @@
  *    increments the counter only AFTER signing, so a counter value can
  *    never be issued twice (usig.c:36-76, comment at 66-69);
  *  - counters start at 1 (usig.c:181, test usig_test.c:34-60);
- *  - key seal/unseal round-trip (usig.c:107-166).  Without SGX there is no
- *    hardware sealing root: the "sealed" blob is the serialized key+epoch
- *    (the same trust level as the reference running in SGX SIM mode, where
- *    sgx_seal_data is simulated in software).
+ *  - key seal/unseal round-trip (usig.c:107-166), with a FRESH random
+ *    epoch drawn on every init — including restores (usig.c:168-186) — so
+ *    a restarted instance whose counter restarts at 1 can never
+ *    re-certify already-issued (epoch, cv) values.  Without SGX there is
+ *    no hardware sealing root: the "sealed" blob is the serialized key
+ *    (the same trust level as the reference running in SGX SIM mode,
+ *    where sgx_seal_data is simulated in software).
  *
  * The byte formats match minbft_tpu/usig/software.py EcdsaUSIG exactly
  * (cert payload, epoch || x || y identity), so UIs created natively verify
@@ -41,9 +44,10 @@ enum {
   USIG_ERR_BUFSZ = 5,
 };
 
-/* Create an instance.  sealed==NULL generates a fresh keypair + epoch;
- * otherwise the keypair + epoch are restored from a previously sealed
- * blob (reference shim.c:35-57 usig_init with/without sealed data). */
+/* Create an instance.  sealed==NULL generates a fresh keypair; otherwise
+ * the keypair is restored from a previously sealed blob (reference
+ * shim.c:35-57 usig_init with/without sealed data).  Either way the
+ * epoch is freshly random (usig.c:177-186). */
 int usig_init(usig_t **out, const uint8_t *sealed, size_t sealed_len);
 int usig_destroy(usig_t *u);
 

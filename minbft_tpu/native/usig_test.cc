@@ -51,10 +51,11 @@ int main() {
     sig[10] ^= 0x40;
   }
 
-  /* seal -> unseal: same key (same pubkey, valid sigs) and same epoch;
+  /* seal -> unseal: same key (same pubkey, valid sigs) but a FRESH epoch
+   * (reference usig.c:168-186 draws a new random epoch on every init);
    * counter restarts at 1 (volatile state, reference usig.c:140-166). */
   size_t need = 0;
-  CHECK(usig_sealed_size(u, &need) == USIG_OK && need > 12);
+  CHECK(usig_sealed_size(u, &need) == USIG_OK && need > 4);
   std::vector<uint8_t> blob(need);
   size_t sealed_len = 0;
   CHECK(usig_seal(u, blob.data(), blob.size(), &sealed_len) == USIG_OK);
@@ -63,20 +64,42 @@ int main() {
   usig_t *u2 = nullptr;
   CHECK(usig_init(&u2, blob.data(), sealed_len) == USIG_OK);
   uint64_t epoch2 = 0;
-  CHECK(usig_get_epoch(u2, &epoch2) == USIG_OK && epoch2 == epoch);
+  CHECK(usig_get_epoch(u2, &epoch2) == USIG_OK && epoch2 != epoch);
   uint8_t pub2[64];
   CHECK(usig_get_pubkey(u2, pub2) == USIG_OK);
   CHECK(std::memcmp(pub, pub2, 64) == 0);
   uint64_t counter = 0;
   CHECK(usig_create_ui(u2, digest, &counter, sig) == USIG_OK);
   CHECK(counter == 1);
-  CHECK(usig_verify_ui(pub, epoch, digest, counter, sig) == USIG_OK);
+  /* the restored instance's counter-1 certificate binds the NEW epoch:
+   * it can never collide with the old instance's (epoch, cv=1) cert. */
+  CHECK(usig_verify_ui(pub, epoch2, digest, counter, sig) == USIG_OK);
+  CHECK(usig_verify_ui(pub, epoch, digest, counter, sig) != USIG_OK);
 
   /* malformed sealed blobs are rejected */
   usig_t *u3 = nullptr;
-  CHECK(usig_init(&u3, blob.data(), 8) == USIG_ERR_SEALED);
+  CHECK(usig_init(&u3, blob.data(), 3) == USIG_ERR_SEALED);
   blob[0] ^= 1;
   CHECK(usig_init(&u3, blob.data(), sealed_len) == USIG_ERR_SEALED);
+  blob[0] ^= 1;
+
+  /* v1 blobs (magic || epoch_be8 || key) still restore the key, with the
+   * stored epoch ignored. */
+  {
+    std::vector<uint8_t> v1;
+    v1.push_back('U'); v1.push_back('S'); v1.push_back('G'); v1.push_back('1');
+    for (int i = 0; i < 8; ++i)
+      v1.push_back(static_cast<uint8_t>(epoch >> (56 - 8 * i)));
+    v1.insert(v1.end(), blob.begin() + 4, blob.begin() + sealed_len);
+    usig_t *u4 = nullptr;
+    CHECK(usig_init(&u4, v1.data(), v1.size()) == USIG_OK);
+    uint64_t epoch4 = 0;
+    CHECK(usig_get_epoch(u4, &epoch4) == USIG_OK && epoch4 != epoch);
+    uint8_t pub4[64];
+    CHECK(usig_get_pubkey(u4, pub4) == USIG_OK);
+    CHECK(std::memcmp(pub, pub4, 64) == 0);
+    CHECK(usig_destroy(u4) == USIG_OK);
+  }
 
   /* small-buffer seal is refused */
   uint8_t tiny[4];

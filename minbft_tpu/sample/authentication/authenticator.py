@@ -18,6 +18,7 @@ Scheme wire formats (canonical, defined by this build):
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 from typing import Dict, Optional, Tuple
 
@@ -97,10 +98,20 @@ class SampleAuthenticator(api.Authenticator):
     ``sig_keys``: {role: (own_private_key, {peer_id: public_key})} for the
     CLIENT/REPLICA roles (only the roles this node plays need a private
     key; pass None).  ``usig``: own USIG instance (replicas only).
-    ``usig_ids``: {replica_id: usig_id bytes} — trust anchors for peers'
-    USIGs (the reference captures epochs trust-on-first-use,
-    crypto.go:204-218; here IDs are distributed via the keystore, which is
-    the stronger and simpler assumption).
+    ``usig_ids``: {replica_id: anchor bytes} — trust anchors for peers'
+    USIGs, in either of two forms:
+
+    - **key-material anchor** (64B ECDSA x||y / 32B HMAC fingerprint, the
+      keystore's ``usigKey``): the peer's epoch is captured
+      trust-on-first-use from its first valid counter-1 UI and pinned
+      thereafter — the reference's SGXUSIGAuthenticationScheme behavior
+      (crypto.go:204-239, assumption comment at 204-218).  A peer restart
+      draws a fresh epoch (reference usig.c:168-186); verifiers that
+      already captured the old epoch reject the new one until an operator
+      re-bootstraps them (:meth:`reset_usig_epoch`), exactly the
+      reference's documented assumption.
+    - **full pinned ID** (epoch || key material, 72B/40B): no capture —
+      for single-run in-process tests where instances live exactly once.
     """
 
     def __init__(
@@ -122,6 +133,12 @@ class SampleAuthenticator(api.Authenticator):
         self._replica_pubs = replica_pubs or {}
         self._usig = usig
         self._usig_ids = usig_ids or {}
+        # TOFU-captured epochs per peer (reference crypto.go:149-152
+        # "USIG key fingerprint -> captured epoch value"), plus one
+        # in-flight first-contact capture future per peer so concurrent
+        # higher-counter UIs wait instead of spuriously failing.
+        self._usig_epochs: Dict[int, bytes] = {}
+        self._usig_epoch_pending: Dict[int, "asyncio.Future"] = {}
         self._engine = engine
         # Batch the public-key signature checks too (on by default; tests
         # may disable it to exercise only the USIG batch path without
@@ -176,16 +193,90 @@ class SampleAuthenticator(api.Authenticator):
             return
         raise api.AuthenticationError(f"unknown role {role}")
 
-    async def _verify_usig(self, peer_id: int, msg: bytes, tag: bytes) -> None:
-        usig_id = self._usig_ids.get(peer_id)
-        if usig_id is None:
+    def reset_usig_epoch(self, peer_id: int) -> None:
+        """Forget the captured epoch for a peer so its next counter-1 UI
+        re-captures — the operator re-bootstrap hook for accepting a
+        restarted peer's fresh epoch (the reference leaves this to "some
+        bootstrapping procedure", crypto.go:219-225)."""
+        self._usig_epochs.pop(peer_id, None)
+
+    def _resolve_usig_id(self, peer_id: int, ui: UI) -> Tuple[bytes, bool]:
+        """Resolve the effective usig_id (epoch || key material) for a
+        peer from its trust anchor; returns (usig_id, capture_needed).
+        ``capture_needed`` is True only when the epoch was taken from the
+        UI certificate itself (first contact) — an epoch read from the
+        captured map must NOT be re-pinned after the verify await, or an
+        in-flight old-epoch UI would silently undo reset_usig_epoch."""
+        anchor = self._usig_ids.get(peer_id)
+        if anchor is None:
             raise api.AuthenticationError(f"unknown USIG for replica {peer_id}")
+        if len(anchor) in (_EPOCH_LEN + 64, _EPOCH_LEN + 32):
+            return anchor, False  # full pinned ID
+        if len(anchor) not in (64, 32):
+            raise api.AuthenticationError("malformed USIG trust anchor")
+        epoch = self._usig_epochs.get(peer_id)
+        if epoch is not None:
+            return epoch + anchor, False
+        # Capture the epoch from the first valid UI — which must carry
+        # counter 1 (reference crypto.go:220-226: epoch is taken from
+        # the cert only when none is captured AND ui.Counter == 1).
+        if ui.counter != 1:
+            raise api.AuthenticationError(
+                f"no captured epoch for replica {peer_id} and UI counter "
+                f"{ui.counter} != 1"
+            )
+        if len(ui.cert) < _EPOCH_LEN:
+            raise api.AuthenticationError("malformed UI certificate")
+        return ui.cert[:_EPOCH_LEN] + anchor, True
+
+    def _capture_usig_epoch(self, peer_id: int, epoch: bytes) -> None:
+        """Pin the epoch after a successful verification.  First capture
+        wins; a concurrently-captured different epoch fails this UI (the
+        reference holds a lock across verify, crypto.go:198-200 — here
+        verification awaits the batch engine, so re-check instead)."""
+        cur = self._usig_epochs.get(peer_id)
+        if cur is None:
+            self._usig_epochs[peer_id] = epoch
+        elif cur != epoch:
+            raise api.AuthenticationError(
+                f"USIG epoch for replica {peer_id} changed during verification"
+            )
+
+    async def _verify_usig(self, peer_id: int, msg: bytes, tag: bytes) -> None:
         try:
             ui = UI.from_bytes(tag)
         except ValueError as e:
             raise api.AuthenticationError(f"malformed UI: {e}") from e
         if ui.counter == 0:
             raise api.AuthenticationError("zero UI counter")
+        try:
+            usig_id, tofu = self._resolve_usig_id(peer_id, ui)
+        except api.AuthenticationError:
+            # Startup race: this peer's counter-1 UI may be mid-verify in
+            # the batch engine (concurrent stream tasks co-batch their UI
+            # checks), so nothing is captured yet.  Wait for the in-flight
+            # first-contact capture, then retry once; if it failed, the
+            # second resolve raises the right error.  (The reference holds
+            # a lock across verify, crypto.go:198-200 — an async analogue.)
+            pending = self._usig_epoch_pending.get(peer_id)
+            if pending is None:
+                raise
+            await pending
+            usig_id, tofu = self._resolve_usig_id(peer_id, ui)
+        if tofu and peer_id not in self._usig_epoch_pending:
+            loop_fut = asyncio.get_event_loop().create_future()
+            self._usig_epoch_pending[peer_id] = loop_fut
+            try:
+                await self._verify_usig_resolved(peer_id, msg, ui, usig_id, tofu)
+            finally:
+                self._usig_epoch_pending.pop(peer_id, None)
+                loop_fut.set_result(None)  # waiters re-resolve either way
+            return
+        await self._verify_usig_resolved(peer_id, msg, ui, usig_id, tofu)
+
+    async def _verify_usig_resolved(
+        self, peer_id: int, msg: bytes, ui: UI, usig_id: bytes, tofu: bool
+    ) -> None:
         usig_scheme = getattr(self._usig, "SCHEME", None)
         if self._engine is not None and usig_scheme == "ecdsa-p256":
             # Batched TPU verification of the UI certificate (the TPU-USIG
@@ -198,6 +289,8 @@ class SampleAuthenticator(api.Authenticator):
                 raise api.AuthenticationError(str(e)) from e
             if not await self._engine.verify_ecdsa_p256(q, payload, sig):
                 raise api.AuthenticationError("invalid UI certificate")
+            if tofu:
+                self._capture_usig_epoch(peer_id, usig_id[:_EPOCH_LEN])
             return
         if self._engine is not None and usig_scheme == "hmac-sha256":
             from ...usig.software import UsigError
@@ -220,6 +313,8 @@ class SampleAuthenticator(api.Authenticator):
                 self._usig._key, payload, mac
             ):
                 raise api.AuthenticationError("invalid UI certificate")
+            if tofu:
+                self._capture_usig_epoch(peer_id, epoch)
             return
         # Serial host fallback (SIM mode without an engine).
         if self._usig is None:
@@ -230,6 +325,8 @@ class SampleAuthenticator(api.Authenticator):
             self._usig.verify_ui(msg, ui, usig_id)
         except UsigError as e:
             raise api.AuthenticationError(str(e)) from e
+        if tofu:
+            self._capture_usig_epoch(peer_id, usig_id[:_EPOCH_LEN])
 
 
 def make_testnet_usigs(n: int, usig_kind: str):

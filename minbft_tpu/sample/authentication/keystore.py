@@ -13,21 +13,25 @@ keeps that shape with its own specs:
   enclave-sealed key is opaque to the reference's Go side
   (keymanager.go:299-328 stores it base64).
 - ``SOFT_ECDSA`` — software-sealed USIG (SIM mode): a self-describing blob
-  holding epoch + private scalar with an integrity checksum.  Like SGX SIM
+  holding the private scalar with an integrity checksum.  Like SGX SIM
   sealing, this provides durability, not confidentiality.
 - ``HMAC_SHA256`` — the shared-key testnet USIG; the blob holds the
-  per-replica epoch + the cluster-shared MAC key.
+  cluster-shared MAC key.
 
-Every usig entry also records the **public** ``usigId`` (epoch || key
-material) — the trust anchors distributed to all peers (the reference
-derives them on load from the enclave/pubkeys; storing them keeps load
-cheap and lets a keystore be distributed with private fields stripped).
+Every usig entry also records the **public** ``usigKey`` — the key
+material (ECDSA x||y, or the HMAC key fingerprint) that anchors trust in
+that replica's USIG (the reference stores the USIG *public key* the same
+way, reference keymanager.go:169-239).  The epoch is deliberately NOT part
+of the anchor: every USIG init draws a fresh random epoch (reference
+usig/sgx/enclave/usig.c:168-186), and verifiers capture each peer's
+current epoch trust-on-first-use from its first counter-1 UI
+(SampleAuthenticator, reference crypto.go:204-218).
 
 Durable-state story (SURVEY.md §5 "checkpoint/resume"): the sealed USIG
 key is the system's only durable state.  ``KeyStore.make_usig`` restores a
-replica's USIG from its sealed blob, so a restarted replica keeps its key
-and epoch — peers' trust anchors remain valid — while the counter restarts
-at 1 (volatile, reference usig/sgx/usig-enclave.go:254-268 semantics).
+replica's USIG from its sealed blob: same key — peers' key anchors remain
+valid — but a fresh epoch and a counter restarting at 1 (volatile), so a
+restart can never re-certify already-issued (epoch, cv) values.
 """
 
 from __future__ import annotations
@@ -42,7 +46,8 @@ from ...utils import hostcrypto as hc
 from .authenticator import SampleAuthenticator
 
 _EPOCH_LEN = 8
-_SOFT_MAGIC = b"SSL1"
+_SOFT_MAGIC = b"SSL2"    # v2: magic || scalar32 || check8 (no epoch)
+_SOFT_MAGIC_V1 = b"SSL1"  # v1 carried a sealed epoch; ignored on restore
 
 
 # --------------------------------------------------------------------------
@@ -79,18 +84,25 @@ _SPEC_FOR_SCHEME = {v[0]: k for k, v in _SIG_SPECS.items()}
 # USIG keyspecs (sealed blobs)
 
 
-def _soft_seal(epoch: bytes, d: int) -> bytes:
-    body = _SOFT_MAGIC + epoch + d.to_bytes(32, "big")
+def _soft_seal(d: int) -> bytes:
+    body = _SOFT_MAGIC + d.to_bytes(32, "big")
     return body + hashlib.sha256(body).digest()[:8]
 
 
-def _soft_unseal(blob: bytes) -> Tuple[bytes, int]:
-    if len(blob) != 4 + _EPOCH_LEN + 32 + 8 or blob[:4] != _SOFT_MAGIC:
+def _soft_unseal(blob: bytes) -> int:
+    """Recover the private scalar; the epoch is never restored (a fresh
+    one is drawn per instance, reference usig.c:168-186).  v1 blobs
+    (which sealed an epoch) are accepted with the epoch discarded."""
+    if len(blob) == 4 + 32 + 8 and blob[:4] == _SOFT_MAGIC:
+        scalar = blob[4:-8]
+    elif len(blob) == 4 + _EPOCH_LEN + 32 + 8 and blob[:4] == _SOFT_MAGIC_V1:
+        scalar = blob[4 + _EPOCH_LEN : -8]
+    else:
         raise ValueError("malformed soft-sealed USIG blob")
     body, check = blob[:-8], blob[-8:]
     if hashlib.sha256(body).digest()[:8] != check:
         raise ValueError("soft-sealed USIG blob failed integrity check")
-    return blob[4 : 4 + _EPOCH_LEN], int.from_bytes(blob[4 + _EPOCH_LEN : -8], "big")
+    return int.from_bytes(scalar, "big")
 
 
 def _new_usig(spec: str, shared_hmac_key: Optional[bytes] = None):
@@ -102,27 +114,36 @@ def _new_usig(spec: str, shared_hmac_key: Optional[bytes] = None):
         return u, u.seal()
     if spec == "SOFT_ECDSA":
         u = EcdsaUSIG()
-        return u, _soft_seal(u.epoch, u._d)
+        return u, _soft_seal(u._d)
     if spec == "HMAC_SHA256":
         key = shared_hmac_key or secrets.token_bytes(32)
-        u = HmacUSIG(key)
-        return u, u.epoch + key
+        return HmacUSIG(key), key
     raise ValueError(f"unknown USIG keyspec {spec!r}")
 
 
 def _restore_usig(spec: str, sealed: bytes):
+    """Restore a USIG from its sealed blob: same key, fresh random epoch,
+    counter restarting at 1 (reference usig.c:168-186)."""
     if spec == "NATIVE_ECDSA":
         from ...usig.native import NativeEcdsaUSIG
 
         return NativeEcdsaUSIG.from_sealed(sealed)
     if spec == "SOFT_ECDSA":
-        epoch, d = _soft_unseal(sealed)
-        return EcdsaUSIG(private_key=d, epoch=epoch)
+        return EcdsaUSIG(private_key=_soft_unseal(sealed))
     if spec == "HMAC_SHA256":
-        if len(sealed) < _EPOCH_LEN + 32:
-            raise ValueError("malformed HMAC USIG blob")
-        return HmacUSIG(sealed[_EPOCH_LEN : _EPOCH_LEN + 32], epoch=sealed[:_EPOCH_LEN])
+        if len(sealed) == 32:
+            return HmacUSIG(sealed)
+        if len(sealed) == _EPOCH_LEN + 32:  # v1 blob: epoch || key
+            return HmacUSIG(sealed[_EPOCH_LEN:])
+        raise ValueError("malformed HMAC USIG blob")
     raise ValueError(f"unknown USIG keyspec {spec!r}")
+
+
+def usig_key_anchor(usig) -> bytes:
+    """The epoch-free trust anchor for a USIG: its ID minus the volatile
+    epoch prefix (= key material: x||y for ECDSA, key fingerprint for
+    HMAC)."""
+    return usig.id()[_EPOCH_LEN:]
 
 
 # --------------------------------------------------------------------------
@@ -150,7 +171,8 @@ class KeyStore:
         # {id: (privateKey bytes|None, publicKey bytes)}
         self.replica_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
         self.client_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
-        # {id: (sealed bytes|None, usig_id bytes)}
+        # {id: (sealed bytes|None, key-material anchor bytes)} — the
+        # anchor is epoch-free (see module docstring).
         self.usig_keys: Dict[int, Tuple[Optional[bytes], bytes]] = {}
         # optional pairwise-MAC material (sample/authentication/mac.py)
         self.mac_keys = None  # Optional[MacKeys]
@@ -202,9 +224,9 @@ class KeyStore:
                             if sealed is not None
                             else {}
                         ),
-                        "usigId": base64.b64encode(uid).decode(),
+                        "usigKey": base64.b64encode(anchor).decode(),
                     }
-                    for kid, (sealed, uid) in sorted(self.usig_keys.items())
+                    for kid, (sealed, anchor) in sorted(self.usig_keys.items())
                 ],
             },
         }
@@ -256,9 +278,15 @@ class KeyStore:
             )
         for entry in usig.get("keys", []):
             sealed = entry.get("sealedKey")
+            if "usigKey" in entry:
+                anchor = base64.b64decode(entry["usigKey"])
+            else:
+                # legacy usigId = epoch(8) || key material: the epoch part
+                # is volatile and must not be pinned — strip it.
+                anchor = base64.b64decode(entry["usigId"])[_EPOCH_LEN:]
             store.usig_keys[int(entry["id"])] = (
                 base64.b64decode(sealed) if sealed else None,
-                base64.b64decode(entry["usigId"]),
+                anchor,
             )
         return store
 
@@ -297,19 +325,25 @@ class KeyStore:
     # -- restoration ---------------------------------------------------------
 
     def make_usig(self, replica_id: int):
-        """Restore replica_id's USIG from its sealed blob (durable state)."""
-        sealed, expect_id = self.usig_keys[replica_id]
+        """Restore replica_id's USIG from its sealed blob (durable state).
+
+        The restored instance has a fresh epoch, so only the key-material
+        anchor — never the full (epoch-bearing) ID — is checked."""
+        sealed, anchor = self.usig_keys[replica_id]
         if sealed is None:
             raise KeyStoreError(f"no sealed USIG key for replica {replica_id}")
         u = _restore_usig(self.usig_spec, sealed)
-        if u.id() != expect_id:
+        if usig_key_anchor(u) != anchor:
             raise KeyStoreError(
-                f"restored USIG id mismatch for replica {replica_id}"
+                f"restored USIG key mismatch for replica {replica_id}"
             )
         return u
 
-    def usig_ids(self) -> Dict[int, bytes]:
-        return {kid: uid for kid, (_, uid) in self.usig_keys.items()}
+    def usig_anchors(self) -> Dict[int, bytes]:
+        """Epoch-free key-material trust anchors, one per replica (what
+        SampleAuthenticator consumes for TOFU epoch capture)."""
+        return {kid: anchor for kid, (_, anchor) in self.usig_keys.items()}
+
 
     def _decode_sig(self, keys, kid: int):
         if kid not in keys:
@@ -335,7 +369,7 @@ class KeyStore:
             replica_pubs=self.replica_pubs(),
             client_pubs=self.client_pubs(),
             usig=self.make_usig(replica_id),
-            usig_ids=self.usig_ids(),
+            usig_ids=self.usig_anchors(),
             engine=engine,
             batch_signatures=batch_signatures,
         )
@@ -352,7 +386,7 @@ class KeyStore:
         n = len(self.usig_keys)
         inner = SampleAuthenticator(
             usig=self.make_usig(replica_id),
-            usig_ids=self.usig_ids(),
+            usig_ids=self.usig_anchors(),
             engine=engine,
             batch_signatures=False,
         )
@@ -411,7 +445,7 @@ def generate_testnet_keys(
     shared = secrets.token_bytes(32) if usig_spec == "HMAC_SHA256" else None
     for i in range(n):
         u, sealed = _new_usig(usig_spec, shared_hmac_key=shared)
-        store.usig_keys[i] = (sealed, u.id())
+        store.usig_keys[i] = (sealed, usig_key_anchor(u))
     if with_macs:
         from .mac import generate_testnet_mac_keys
 

@@ -212,6 +212,13 @@ class MacAuthenticator(api.Authenticator):
             return
         raise api.AuthenticationError(f"unknown role {role}")
 
+    def reset_usig_epoch(self, peer_id: int) -> None:
+        """Operator re-bootstrap hook (see SampleAuthenticator): forwarded
+        to the inner USIG authenticator so --auth mac deployments can
+        re-accept a restarted replica's fresh epoch."""
+        if self._inner is not None:
+            self._inner.reset_usig_epoch(peer_id)
+
 
 def new_test_mac_authenticators(
     n: int,

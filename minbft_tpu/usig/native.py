@@ -12,7 +12,13 @@ back to the pure-Python :class:`minbft_tpu.usig.software.EcdsaUSIG`.
 its UIs verify on the TPU batch path (usig_verify_items) unchanged.  Unlike
 the Python class it supports key **sealing**: ``seal()`` exports a blob
 that ``from_sealed`` restores — the durable-state story of the reference
-(sealed USIG key in keys.yaml, reference keymanager.go:299-328).
+(sealed USIG key in keys.yaml, reference keymanager.go:299-328).  Only the
+KEY is sealed: every init draws a fresh random epoch (reference
+usig/sgx/enclave/usig.c:168-186), so a restored instance — whose counter
+restarts at 1 — can never re-certify (epoch, cv) values issued by a
+previous instance of the same key.  Verifiers learn the new epoch
+trust-on-first-use (SampleAuthenticator epoch capture, reference
+sample/authentication/crypto.go:204-218).
 """
 
 from __future__ import annotations
@@ -198,8 +204,9 @@ class NativeEcdsaUSIG(USIG):
     # -- sealing (durable state) --------------------------------------------
 
     def seal(self) -> bytes:
-        """Export the sealed key+epoch blob (reference SealedKey,
-        usig/sgx/usig-enclave.go:254-268)."""
+        """Export the sealed key blob (reference SealedKey,
+        usig/sgx/usig-enclave.go:254-268).  The epoch is volatile by
+        design and is not part of the blob."""
         need = ctypes.c_size_t()
         if self._lib.usig_sealed_size(self._h, ctypes.byref(need)) != USIG_OK:
             raise UsigError("usig_sealed_size failed")
@@ -212,5 +219,6 @@ class NativeEcdsaUSIG(USIG):
 
     @classmethod
     def from_sealed(cls, sealed: bytes) -> "NativeEcdsaUSIG":
-        """Restore an instance (same key + epoch, counter restarts at 1)."""
+        """Restore an instance: same key, FRESH epoch, counter restarts
+        at 1 (reference usig.c:168-186)."""
         return cls(sealed=sealed)

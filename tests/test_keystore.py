@@ -2,8 +2,9 @@
 
 Covers: generate → save → load round-trip for each keyspec, authenticator
 construction from a loaded store (cross sign/verify between two replicas
-and a client), sealed-USIG restoration (same id/epoch — the durable-state
-story), private-key stripping, and integrity failure on tamper.
+and a client), sealed-USIG restoration (same key, fresh epoch — the
+durable-state story), private-key stripping, and integrity failure on
+tamper.
 """
 
 import asyncio
@@ -56,24 +57,35 @@ def test_generate_save_load_verify(tmp_path, usig_spec):
     asyncio.run(run())
 
 
-def test_sealed_usig_restores_same_identity(tmp_path):
+def test_sealed_usig_restores_same_key_fresh_epoch(tmp_path):
+    from minbft_tpu.sample.authentication.keystore import usig_key_anchor
+
     store = _roundtrip(tmp_path, generate_testnet_keys(2, usig_spec="SOFT_ECDSA"))
     u_first = store.make_usig(0)
     u_again = store.make_usig(0)  # "replica restart"
-    assert u_first.id() == u_again.id() == store.usig_ids()[0]
+    # same key material anchor, but a fresh epoch per restore (reference
+    # usig.c:168-186) — so the two restored instances' counter-1 certs
+    # can never equivocate under one (epoch, cv).
+    assert (
+        usig_key_anchor(u_first)
+        == usig_key_anchor(u_again)
+        == store.usig_anchors()[0]
+    )
+    assert u_first.epoch != u_again.epoch
     # counters are volatile: both restored instances start at 1
     assert u_first.create_ui(b"x").counter == 1
     assert u_again.create_ui(b"x").counter == 1
 
 
 def test_native_sealed_usig_roundtrip(tmp_path):
+    from minbft_tpu.sample.authentication.keystore import usig_key_anchor
     from minbft_tpu.usig import native as native_mod
 
     if not native_mod.available(auto_build=True):
         pytest.skip("native USIG module unavailable")
     store = _roundtrip(tmp_path, generate_testnet_keys(2, usig_spec="NATIVE_ECDSA"))
     u = store.make_usig(0)
-    assert u.id() == store.usig_ids()[0]
+    assert usig_key_anchor(u) == store.usig_anchors()[0]
     ui = u.create_ui(b"native")
     u.verify_ui(b"native", ui, u.id())
 
@@ -97,7 +109,7 @@ def test_strip_private(tmp_path):
     with pytest.raises(KeyStoreError):
         public.client_authenticator(0)
     # trust anchors survive
-    assert public.usig_ids() == store.usig_ids()
+    assert public.usig_anchors() == store.usig_anchors()
 
 
 def test_keytool_generate(tmp_path):

@@ -84,17 +84,26 @@ def test_native_ui_verifies_on_tpu_batch_path():
     assert out.tolist() == [True, False] + [True] * 6
 
 
-def test_seal_restores_key_and_epoch():
+def test_seal_restores_key_with_fresh_epoch():
+    """Restore = same key, FRESH epoch, counter back at 1 (reference
+    usig.c:168-186): the restored instance can never re-certify an
+    (epoch, cv) pair the old instance already issued."""
     u = native_mod.NativeEcdsaUSIG()
     blob = u.seal()
     ui1 = u.create_ui(b"before")
 
     r = native_mod.NativeEcdsaUSIG.from_sealed(blob)
-    assert r.id() == u.id()  # same epoch + pubkey: trust anchors stable
+    assert r.public_key == u.public_key  # same key: anchors stable
+    assert r.epoch != u.epoch  # fresh epoch per init
     ui2 = r.create_ui(b"after")
     assert ui2.counter == 1  # counter is volatile state
-    r.verify_ui(b"after", ui2, u.id())
-    u.verify_ui(b"before", ui1, r.id())
+    # each instance's certs verify only under its own epoch-bearing ID
+    r.verify_ui(b"after", ui2, r.id())
+    u.verify_ui(b"before", ui1, u.id())
+    with pytest.raises(UsigError):
+        r.verify_ui(b"after", ui2, u.id())  # old-epoch ID rejects new cert
+    with pytest.raises(UsigError):
+        u.verify_ui(b"before", ui1, r.id())
 
     with pytest.raises(UsigError):
         native_mod.NativeEcdsaUSIG.from_sealed(b"\x00" * 20)

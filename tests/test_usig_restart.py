@@ -1,0 +1,142 @@
+"""USIG restart semantics: fresh epoch per init + TOFU anchor capture.
+
+The reference enclave draws a new random epoch on EVERY init — including
+restores from a sealed key (reference usig/sgx/enclave/usig.c:168-186,
+comment at 177-182) — so a restarted instance whose counter restarts at 1
+can never re-certify already-issued (epoch, cv) values.  Verifiers capture
+each peer's epoch trust-on-first-use from its first valid counter-1 UI
+(reference sample/authentication/crypto.go:204-239).
+
+This file is the done-criterion matrix for that behavior:
+- restore → same key, fresh epoch, counter 1 (soft, HMAC and native specs);
+- a verifier that captured the old epoch REJECTS the restarted instance's
+  UIs (no counter-reset equivocation), and accepts them again only after
+  the operator re-bootstrap hook (reset_usig_epoch);
+- a crafted counter-reuse attempt (old epoch spliced onto a new-epoch
+  cert) is rejected;
+- TOFU capture itself requires counter == 1.
+"""
+
+import asyncio
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.sample.authentication import generate_testnet_keys
+from minbft_tpu.sample.authentication.keystore import usig_key_anchor
+
+ROLE = api.AuthenticationRole.USIG
+
+
+def _verify(auth, peer, msg, tag):
+    asyncio.run(auth.verify_message_authen_tag(ROLE, peer, msg, tag))
+
+
+def _expect_reject(auth, peer, msg, tag):
+    with pytest.raises(api.AuthenticationError):
+        _verify(auth, peer, msg, tag)
+
+
+@pytest.mark.parametrize("usig_spec", ["SOFT_ECDSA", "HMAC_SHA256"])
+def test_restart_cannot_equivocate_and_rebootstrap(usig_spec):
+    store = generate_testnet_keys(2, usig_spec=usig_spec)
+    signer = store.replica_authenticator(0)
+    verifier = store.replica_authenticator(1)
+
+    # First UI (counter 1) captures replica 0's epoch at the verifier.
+    t1 = signer.generate_message_authen_tag(ROLE, b"msg-1")
+    _verify(verifier, 0, b"msg-1", t1)
+    t2 = signer.generate_message_authen_tag(ROLE, b"msg-2")
+    _verify(verifier, 0, b"msg-2", t2)
+
+    # Replica 0 "restarts": same sealed key, fresh epoch, counter back
+    # at 1.  Its new counter-1 UI certifies a DIFFERENT message than the
+    # old counter-1 UI — the equivocation the epoch exists to prevent.
+    restarted = store.replica_authenticator(0)
+    t1b = restarted.generate_message_authen_tag(ROLE, b"msg-OTHER")
+    _expect_reject(verifier, 0, b"msg-OTHER", t1b)  # old epoch pinned
+
+    # A verifier that never saw the old instance captures the new epoch
+    # (and will in turn reject the OLD instance's certs).
+    fresh_verifier = store.replica_authenticator(1)
+    _verify(fresh_verifier, 0, b"msg-OTHER", t1b)
+    _expect_reject(fresh_verifier, 0, b"msg-1", t1)
+
+    # Operator re-bootstrap: after resetting the anchor, the original
+    # verifier accepts the restarted instance — but only from counter 1.
+    verifier.reset_usig_epoch(0)
+    _verify(verifier, 0, b"msg-OTHER", t1b)
+    # ...and the old instance's certs are now rejected there too.
+    _expect_reject(verifier, 0, b"msg-2", t2)
+
+
+def test_crafted_counter_reuse_rejected():
+    """Splicing the captured (old) epoch onto a restarted instance's
+    signature must fail: the signature binds the epoch."""
+    store = generate_testnet_keys(2, usig_spec="SOFT_ECDSA")
+    signer = store.replica_authenticator(0)
+    verifier = store.replica_authenticator(1)
+    t1 = signer.generate_message_authen_tag(ROLE, b"honest")
+    _verify(verifier, 0, b"honest", t1)
+    old_epoch = t1[8:16]  # tag = counter_be8 || cert(epoch8 || sig)
+
+    restarted = store.replica_authenticator(0)
+    t1b = restarted.generate_message_authen_tag(ROLE, b"equivocation")
+    forged = t1b[:8] + old_epoch + t1b[16:]
+    _expect_reject(verifier, 0, b"equivocation", forged)
+
+
+def test_tofu_first_capture_requires_counter_one():
+    store = generate_testnet_keys(2, usig_spec="SOFT_ECDSA")
+    signer = store.replica_authenticator(0)
+    verifier = store.replica_authenticator(1)
+    t1 = signer.generate_message_authen_tag(ROLE, b"a")  # counter 1
+    t2 = signer.generate_message_authen_tag(ROLE, b"b")  # counter 2
+    # Out-of-order first contact: counter-2 UI cannot establish the epoch
+    # (reference crypto.go:220-226 takes the cert epoch only for cv==1).
+    _expect_reject(verifier, 0, b"b", t2)
+    _verify(verifier, 0, b"a", t1)
+    _verify(verifier, 0, b"b", t2)
+
+
+def test_concurrent_first_contact_waits_for_capture():
+    """Startup race: a peer's counter-2 UI verified concurrently with its
+    counter-1 UI (batch-engine co-batching) must wait for the in-flight
+    first-contact epoch capture instead of spuriously failing."""
+    from minbft_tpu.sample.authentication.authenticator import SampleAuthenticator
+    from minbft_tpu.usig.software import EcdsaUSIG
+    from minbft_tpu.utils import hostcrypto as hc
+
+    class SlowEngine:
+        async def verify_ecdsa_p256(self, q, payload, sig):
+            await asyncio.sleep(0.02)  # models the device round trip
+            return hc.ecdsa_verify(q, payload, sig)
+
+    signer = EcdsaUSIG()
+    anchor = signer.id()[8:]  # epoch-free key anchor → TOFU mode
+    verifier = SampleAuthenticator(
+        usig=EcdsaUSIG(), usig_ids={0: anchor}, engine=SlowEngine()
+    )
+    t1 = signer.create_ui(b"first").to_bytes()
+    t2 = signer.create_ui(b"second").to_bytes()
+
+    async def run():
+        await asyncio.gather(
+            verifier.verify_message_authen_tag(ROLE, 0, b"first", t1),
+            verifier.verify_message_authen_tag(ROLE, 0, b"second", t2),
+        )
+
+    asyncio.run(run())
+
+
+def test_native_restart_fresh_epoch():
+    from minbft_tpu.usig import native as native_mod
+
+    if not native_mod.available(auto_build=True):
+        pytest.skip("native USIG module unavailable")
+    store = generate_testnet_keys(2, usig_spec="NATIVE_ECDSA")
+    u1 = store.make_usig(0)
+    u2 = store.make_usig(0)  # restart
+    assert usig_key_anchor(u1) == usig_key_anchor(u2)
+    assert u1.epoch != u2.epoch
+    assert u2.create_ui(b"x").counter == 1
