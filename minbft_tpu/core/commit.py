@@ -21,7 +21,7 @@ so quorum accounting is pure in-memory bookkeeping on the event loop.
 from __future__ import annotations
 
 import asyncio
-from typing import Awaitable, Callable, Dict, Set, Tuple
+from typing import Awaitable, Callable, Dict, Tuple
 
 from .. import api
 from ..messages import Commit, Prepare
@@ -65,21 +65,46 @@ def make_commit_applier(
 class CommitmentCollector:
     """Acceptor + counter + in-order executor release
     (reference makeCommitmentCollector/Acceptor/Counter,
-    core/commit.go:108-201)."""
+    core/commit.go:108-201).
+
+    Memory is bounded the way the reference bounds it: the acceptor keeps
+    one (view, last-CV) pair per replica (commit.go:145-175) and the
+    counter keeps only the **f highest primary-CVs** of the current view
+    (commit.go:177-201) — a commitment is "done" exactly when f other
+    replicas have already committed an equal-or-higher CV, which (because
+    each replica's CVs are sequential) implies f+1 distinct replicas
+    committed this CV.  Nothing grows with the number of requests served."""
 
     def __init__(self, f: int, execute_request):
         self._f = f
         self._execute = execute_request
         self._lock = asyncio.Lock()
         self._exec_lock = asyncio.Lock()  # serializes state-machine execution
-        # acceptor state: per replica, last accepted primary-CV per view
-        self._last_cv: Dict[Tuple[int, int], int] = {}  # (view, replica) -> cv
-        # counter state: per (view, primary-cv), set of committers
-        self._committers: Dict[Tuple[int, int], Set[int]] = {}
-        self._done: Set[Tuple[int, int]] = set()
-        # executor-release state: next primary CV to execute per view
+        # acceptor state: per replica, (view, last accepted primary-CV)
+        self._accepted: Dict[int, Tuple[int, int]] = {}
+        # counter state (reference commit.go:177-201): current view + the
+        # f highest primary-CVs committed in it
+        self._counter_view = 0
+        self._highest = [0] * f
+        # executor-release state: next primary CV to execute per view,
+        # plus quorum-complete prepares awaiting in-order release
         self._next_exec_cv: Dict[int, int] = {}
         self._ready: Dict[Tuple[int, int], Prepare] = {}
+
+    def _count(self, view: int, primary_cv: int) -> bool:
+        """Reference makeCommitmentCounter (commit.go:177-201): True when
+        f commitments with CV ≥ primary_cv were already counted in this
+        view (so with the current one the quorum is f+1)."""
+        if view < self._counter_view:
+            return False
+        if view > self._counter_view:
+            self._counter_view = view
+            self._highest = [0] * self._f
+        for i, cv in enumerate(self._highest):
+            if primary_cv > cv:
+                self._highest[i] = primary_cv
+                return False
+        return True
 
     async def collect(self, replica_id: int, prepare: Prepare) -> None:
         """Account one commitment by ``replica_id`` to ``prepare``; executes
@@ -89,8 +114,11 @@ class CommitmentCollector:
         view = prepare.view
         primary_cv = prepare.ui.counter
         async with self._lock:
-            key = (view, replica_id)
-            last = self._last_cv.get(key, 0)
+            cur_view, last = self._accepted.get(replica_id, (view, 0))
+            if view < cur_view:
+                return  # commitment from an abandoned view
+            if view > cur_view:
+                last = 0  # new view: CV numbering restarts
             if primary_cv <= last:
                 return  # replayed commitment — already accounted
             if primary_cv != last + 1:
@@ -98,17 +126,16 @@ class CommitmentCollector:
                     f"replica {replica_id} commitment skips CV "
                     f"{last + 1} -> {primary_cv}"
                 )
-            self._last_cv[key] = primary_cv
+            self._accepted[replica_id] = (view, primary_cv)
 
+            if not self._count(view, primary_cv):
+                return
             ckey = (view, primary_cv)
-            if ckey in self._done:
+            # The counter may report done again for stragglers of an
+            # already-released quorum (it has no per-CV memory); the
+            # in-order release watermark is the dedup.
+            if primary_cv < self._next_exec_cv.get(view, 1) or ckey in self._ready:
                 return
-            committers = self._committers.setdefault(ckey, set())
-            committers.add(replica_id)
-            if len(committers) < self._f + 1:
-                return
-            self._done.add(ckey)
-            del self._committers[ckey]
             self._ready[ckey] = prepare
         await self._drain(view)
 

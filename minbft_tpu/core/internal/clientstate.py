@@ -20,17 +20,25 @@ from .timer import TimerProvider, StandardTimerProvider
 
 
 class ClientState:
+    """All containers here are O(1) per client — a long-lived replica's
+    memory must not grow with the number of requests served (the
+    reference keeps a single last-reply slot, reply.go:25-60, and scalar
+    seq watermarks, request-seq.go:28-45)."""
+
     def __init__(self, timer_provider: TimerProvider):
         self._timers = timer_provider
         # request-seq state machine (reference request-seq.go:28-45)
         self._last_captured = 0
         self._last_released = 0
-        self._prepared: Dict[int, bool] = {}
+        self._last_prepared = 0
         self._retired = 0
         self._cond = asyncio.Condition()
-        # reply buffer (reference reply.go)
-        self._replies: Dict[int, object] = {}
-        self._reply_events: Dict[int, asyncio.Event] = {}
+        # reply buffer: ONE last-reply slot (reference reply.go:25-38
+        # lastRepliedSeq + reply); the event is swapped on each add so
+        # waiters from any earlier add are woken exactly once.
+        self._last_replied_seq = 0
+        self._reply: Optional[object] = None
+        self._reply_event = asyncio.Event()
         # timers (reference timeout.go)
         self._request_timer = None
         self._prepare_timer = None
@@ -62,8 +70,18 @@ class ClientState:
             self._cond.notify_all()
 
     def prepare_request_seq(self, seq: int) -> None:
-        """Mark ``seq`` prepared (reference request-seq.go:99-106)."""
-        self._prepared[seq] = True
+        """Mark ``seq`` prepared (reference request-seq.go:99-106).  A
+        scalar watermark suffices: seqs are captured one-at-a-time per
+        client, so at most one seq is between captured and retired.
+        Nothing reads the watermark yet — like the reference's prepared
+        flag it exists for the view-change path (retransmitting prepared-
+        but-unexecuted requests), which is roadmap in both builds."""
+        if seq > self._last_prepared:
+            self._last_prepared = seq
+
+    @property
+    def last_prepared_seq(self) -> int:
+        return self._last_prepared
 
     def retire_request_seq(self, seq: int) -> bool:
         """Mark ``seq`` executed; returns False if already retired
@@ -80,21 +98,26 @@ class ClientState:
     # -- reply buffer --------------------------------------------------------
 
     def add_reply(self, seq: int, reply) -> None:
-        """Store the reply for ``seq`` and wake subscribers
-        (reference reply.go:41-64)."""
-        self._replies[seq] = reply
-        ev = self._reply_events.get(seq)
-        if ev is not None:
-            ev.set()
+        """Store the reply as the client's LAST reply and wake subscribers
+        (reference reply.go:41-60: old seqs are rejected; only one reply
+        slot is kept)."""
+        if seq <= self._last_replied_seq:
+            return  # stale (reference AddReply "old request ID")
+        self._reply = reply
+        self._last_replied_seq = seq
+        ev, self._reply_event = self._reply_event, asyncio.Event()
+        ev.set()
 
-    async def reply_for(self, seq: int) -> object:
-        """Await the reply for ``seq`` (reference reply.go:66-90
-        ReplyChannel subscription)."""
-        if seq in self._replies:
-            return self._replies[seq]
-        ev = self._reply_events.setdefault(seq, asyncio.Event())
-        await ev.wait()
-        return self._replies[seq]
+    async def reply_for(self, seq: int) -> Optional[object]:
+        """Await the reply for ``seq`` (reference reply.go:62-80
+        ReplyChannel): waits until the client's replied watermark reaches
+        ``seq``; returns None if ``seq`` itself was skipped over (the
+        reference closes the channel without sending) — per-client
+        execution is in seq order, so this only happens for stale
+        retries of already-superseded seqs."""
+        while self._last_replied_seq < seq:
+            await self._reply_event.wait()
+        return self._reply if self._last_replied_seq == seq else None
 
     # -- timers --------------------------------------------------------------
 

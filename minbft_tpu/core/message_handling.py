@@ -320,14 +320,15 @@ class Handlers:
             capture_seq, self.pending, self.view_state, _process_request_apply
         )
 
-        # --- commit pipeline / quorum
-        base_collect = commit_mod.make_commitment_collector(
+        # --- commit pipeline / quorum (instance kept visible so tests can
+        # assert its containers stay bounded)
+        self.commitment_collector = commit_mod.CommitmentCollector(
             f, self.execute_request
         )
 
         async def collect_counted(peer_id: int, prepare: Prepare) -> None:
             self.metrics.inc("commitments_counted")
-            await base_collect(peer_id, prepare)
+            await self.commitment_collector.collect(peer_id, prepare)
 
         self.collect_commitment = collect_counted
         self.apply_commit = commit_mod.make_commit_applier(self.collect_commitment)
@@ -439,7 +440,7 @@ class Handlers:
     # Top-level handlers (reference handleClientMessage / handlePeerMessage /
     # handleOwnMessage, core/message-handling.go:352-403).
 
-    async def handle_client_message(self, msg: Message) -> Reply:
+    async def handle_client_message(self, msg: Message) -> Optional[Reply]:
         if not isinstance(msg, Request):
             raise api.AuthenticationError("client stream accepts only REQUEST")
         self.metrics.inc("messages_handled")
@@ -448,6 +449,10 @@ class Handlers:
         await self.process_message(msg)
         # Reply once executed (even to a duplicate request — the client may
         # be retrying a lost reply, reference message-handling.go:396-403).
+        # None for a stale retry of a superseded seq: only the client's
+        # LAST reply is buffered (reference reply.go:25-60), so there is
+        # nothing to send (the reference closes the reply channel without
+        # sending, reply.go:74-79).
         return await self.reply_request(msg)
 
     async def handle_peer_message(self, msg: Message) -> None:
@@ -616,6 +621,11 @@ class ClientStreamHandler(api.MessageStreamHandler):
 
         async def handle_one(msg: Message) -> None:
             reply = await h.handle_client_message(msg)
+            if reply is None:
+                # Stale retry of a superseded seq: the last-reply buffer
+                # skipped past it (reference ReplyChannel closes without
+                # sending, reply.go:74-79).
+                return
             await out_queue.put(marshal(reply))
 
         # Requests are handled concurrently (replies may take a quorum

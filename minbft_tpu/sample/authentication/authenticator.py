@@ -139,6 +139,9 @@ class SampleAuthenticator(api.Authenticator):
         # higher-counter UIs wait instead of spuriously failing.
         self._usig_epochs: Dict[int, bytes] = {}
         self._usig_epoch_pending: Dict[int, "asyncio.Future"] = {}
+        # How long a non-counter-1 UI waits for a first-contact capture
+        # before rejecting (only relevant before a peer's epoch is known).
+        self.tofu_capture_timeout = 10.0
         self._engine = engine
         # Batch the public-key signature checks too (on by default; tests
         # may disable it to exercise only the USIG batch path without
@@ -252,25 +255,50 @@ class SampleAuthenticator(api.Authenticator):
         try:
             usig_id, tofu = self._resolve_usig_id(peer_id, ui)
         except api.AuthenticationError:
-            # Startup race: this peer's counter-1 UI may be mid-verify in
-            # the batch engine (concurrent stream tasks co-batch their UI
-            # checks), so nothing is captured yet.  Wait for the in-flight
-            # first-contact capture, then retry once; if it failed, the
-            # second resolve raises the right error.  (The reference holds
-            # a lock across verify, crypto.go:198-200 — an async analogue.)
-            pending = self._usig_epoch_pending.get(peer_id)
-            if pending is None:
-                raise
-            await pending
+            # Startup race: this peer's counter-1 UI may be concurrently
+            # in flight (concurrent stream tasks co-batch their UI checks)
+            # but not yet captured — it may not even have reached
+            # _verify_usig yet.  Wait (bounded) on a shared per-peer
+            # future that the first-contact verification completes, then
+            # retry the resolve once; if nothing was captured meanwhile,
+            # the second resolve raises the right error.  (The reference
+            # holds a lock across verify, crypto.go:198-200 — this is the
+            # async analogue.)
+            if self._usig_ids.get(peer_id) is None:
+                raise  # unknown peer: waiting can't help
+            fut = self._usig_epoch_pending.get(peer_id)
+            if fut is None:
+                fut = asyncio.get_event_loop().create_future()
+                self._usig_epoch_pending[peer_id] = fut
+            try:
+                await asyncio.wait_for(
+                    asyncio.shield(fut), self.tofu_capture_timeout
+                )
+            except asyncio.TimeoutError:
+                if self._usig_epoch_pending.get(peer_id) is fut:
+                    self._usig_epoch_pending.pop(peer_id, None)
+                if self._usig_epochs.get(peer_id) is None:
+                    raise api.AuthenticationError(
+                        f"no counter-1 UI from replica {peer_id} to "
+                        "establish its USIG epoch"
+                    ) from None
             usig_id, tofu = self._resolve_usig_id(peer_id, ui)
-        if tofu and peer_id not in self._usig_epoch_pending:
-            loop_fut = asyncio.get_event_loop().create_future()
-            self._usig_epoch_pending[peer_id] = loop_fut
+        if tofu:
+            # First contact: make sure a pending future exists for
+            # concurrent non-counter-1 UIs to wait on, and complete it
+            # when this verification settles (success or failure — the
+            # waiters re-resolve and get the accurate outcome).
+            fut = self._usig_epoch_pending.get(peer_id)
+            if fut is None:
+                fut = asyncio.get_event_loop().create_future()
+                self._usig_epoch_pending[peer_id] = fut
             try:
                 await self._verify_usig_resolved(peer_id, msg, ui, usig_id, tofu)
             finally:
-                self._usig_epoch_pending.pop(peer_id, None)
-                loop_fut.set_result(None)  # waiters re-resolve either way
+                if self._usig_epoch_pending.get(peer_id) is fut:
+                    self._usig_epoch_pending.pop(peer_id, None)
+                if not fut.done():
+                    fut.set_result(None)
             return
         await self._verify_usig_resolved(peer_id, msg, ui, usig_id, tofu)
 

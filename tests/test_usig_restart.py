@@ -129,6 +129,48 @@ def test_concurrent_first_contact_waits_for_capture():
     asyncio.run(run())
 
 
+def test_counter2_first_waits_for_late_counter1():
+    """Even when the counter-2 UI reaches the authenticator BEFORE the
+    counter-1 UI does, it must wait (bounded) for the first-contact
+    capture rather than reject."""
+    from minbft_tpu.sample.authentication.authenticator import SampleAuthenticator
+    from minbft_tpu.usig.software import EcdsaUSIG
+    from minbft_tpu.utils import hostcrypto as hc
+
+    class Engine:
+        async def verify_ecdsa_p256(self, q, payload, sig):
+            return hc.ecdsa_verify(q, payload, sig)
+
+    signer = EcdsaUSIG()
+    verifier = SampleAuthenticator(
+        usig=EcdsaUSIG(), usig_ids={0: signer.id()[8:]}, engine=Engine()
+    )
+    t1 = signer.create_ui(b"a").to_bytes()
+    t2 = signer.create_ui(b"b").to_bytes()
+
+    async def run():
+        task2 = asyncio.create_task(
+            verifier.verify_message_authen_tag(ROLE, 0, b"b", t2)
+        )
+        await asyncio.sleep(0.01)  # t2 is now parked on the pending future
+        await verifier.verify_message_authen_tag(ROLE, 0, b"a", t1)
+        await asyncio.wait_for(task2, timeout=5)
+
+    asyncio.run(run())
+
+
+def test_counter2_rejected_when_counter1_never_arrives():
+    from minbft_tpu.sample.authentication.authenticator import SampleAuthenticator
+    from minbft_tpu.usig.software import EcdsaUSIG
+
+    signer = EcdsaUSIG()
+    verifier = SampleAuthenticator(usig=EcdsaUSIG(), usig_ids={0: signer.id()[8:]})
+    verifier.tofu_capture_timeout = 0.05
+    signer.create_ui(b"a")  # counter 1 never shown to the verifier
+    t2 = signer.create_ui(b"b").to_bytes()
+    _expect_reject(verifier, 0, b"b", t2)
+
+
 def test_native_restart_fresh_epoch():
     from minbft_tpu.usig import native as native_mod
 
