@@ -4,7 +4,9 @@
 set -e
 cd /data
 
-if [ ! -f consensus.yaml ]; then
+# Re-scaffold when the per-replica stripped keystores are missing too
+# (migration from volumes populated before keys.replicaN.yaml existed).
+if [ ! -f consensus.yaml ] || [ ! -f keys.replica0.yaml ]; then
     if mkdir .scaffold.lock 2>/dev/null; then
         # Drop the lock even if scaffolding dies mid-way, so a restarted
         # compose run can take over instead of waiting forever.

@@ -15,9 +15,11 @@ pids=()
 cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
 trap cleanup EXIT
 
+# Each replica runs from its least-privilege keystore copy (only its own
+# private material); the full keys.yaml stays client/operator-side.
 for i in $(seq 0 $((N - 1))); do
     python -m minbft_tpu.sample.peer \
-        --keys "$DIR/keys.yaml" --config "$DIR/consensus.yaml" \
+        --keys "$DIR/keys.replica$i.yaml" --config "$DIR/consensus.yaml" \
         run "$i" --no-batch >"$DIR/replica$i.log" 2>&1 &
     pids+=($!)
 done

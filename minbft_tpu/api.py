@@ -35,6 +35,18 @@ class AuthenticationError(Exception):
     """Tag failed to verify."""
 
 
+class EmbeddedRequestAuthError(AuthenticationError):
+    """A UI-certified proposal (PREPARE/COMMIT) embeds a REQUEST whose
+    client authentication fails locally while the proposal's own UI is
+    valid.  Under signature schemes every correct replica agrees on the
+    check, but under per-pair MAC authentication a faulty client can
+    craft a MAC vector that verifies at the primary and fails at a
+    backup — the backup then cannot capture the primary's UI counter and
+    every later message from that primary parks behind the gap.  Raised
+    distinctly so message handling can demand a view change (depose the
+    wedged primary) instead of stalling silently."""
+
+
 class Authenticator(abc.ABC):
     """Message authentication provider (reference api/api.go:93-132).
 

@@ -14,6 +14,7 @@ Reference core/internal/clientstate/: three sub-machines per client —
 from __future__ import annotations
 
 import asyncio
+from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from .timer import TimerProvider, StandardTimerProvider
@@ -33,11 +34,18 @@ class ClientState:
         self._last_prepared = 0
         self._retired = 0
         self._cond = asyncio.Condition()
-        # reply buffer: ONE last-reply slot (reference reply.go:25-38
-        # lastRepliedSeq + reply); the event is swapped on each add so
-        # waiters from any earlier add are woken exactly once.
+        # Reply buffer: a bounded WINDOW of recent replies.  The reference
+        # keeps exactly one last-reply slot (reply.go:25-38) — sound there
+        # because its clients are strictly serial (requestbuffer's
+        # single-capacity slot).  This build's clients pipeline up to
+        # max_inflight requests, so replies k and k+1 can both land before
+        # the waiter for k wakes; a single slot would skip k and strand
+        # the client.  The window (insertion = execution = seq order)
+        # bounds memory at O(_REPLY_WINDOW) per client while covering any
+        # sane pipeline depth; the event is swapped on each add so waiters
+        # from any earlier add are woken exactly once.
         self._last_replied_seq = 0
-        self._reply: Optional[object] = None
+        self._replies: "OrderedDict[int, object]" = OrderedDict()
         self._reply_event = asyncio.Event()
         # timers (reference timeout.go)
         self._request_timer = None
@@ -97,27 +105,31 @@ class ClientState:
 
     # -- reply buffer --------------------------------------------------------
 
+    _REPLY_WINDOW = 128  # >= any client pipeline depth; O(1) per client
+
     def add_reply(self, seq: int, reply) -> None:
-        """Store the reply as the client's LAST reply and wake subscribers
-        (reference reply.go:41-60: old seqs are rejected; only one reply
-        slot is kept)."""
-        if seq <= self._last_replied_seq:
+        """Store the reply in the bounded window and wake subscribers
+        (reference reply.go:41-60, generalized for pipelined clients —
+        see the constructor comment)."""
+        if seq <= self._last_replied_seq and seq not in self._replies:
             return  # stale (reference AddReply "old request ID")
-        self._reply = reply
-        self._last_replied_seq = seq
+        self._replies[seq] = reply
+        if seq > self._last_replied_seq:
+            self._last_replied_seq = seq
+        while len(self._replies) > self._REPLY_WINDOW:
+            self._replies.popitem(last=False)
         ev, self._reply_event = self._reply_event, asyncio.Event()
         ev.set()
 
     async def reply_for(self, seq: int) -> Optional[object]:
         """Await the reply for ``seq`` (reference reply.go:62-80
         ReplyChannel): waits until the client's replied watermark reaches
-        ``seq``; returns None if ``seq`` itself was skipped over (the
-        reference closes the channel without sending) — per-client
-        execution is in seq order, so this only happens for stale
-        retries of already-superseded seqs."""
+        ``seq``; returns None if ``seq`` was pruned out of the window (a
+        stale retry far behind the pipeline — the reference closes the
+        channel without sending)."""
         while self._last_replied_seq < seq:
             await self._reply_event.wait()
-        return self._reply if self._last_replied_seq == seq else None
+        return self._replies.get(seq)
 
     # -- timers --------------------------------------------------------------
 

@@ -1,17 +1,20 @@
 """View number state (reference core/internal/viewstate/view-state.go:50-105).
 
-Tracks the current and expected view under an async RW-style discipline:
-``hold_view`` is the read-lease used by message processing (the reference
-takes a read lock and returns a release closure), ``advance_expected_view``
-/ ``advance_current_view`` move the view-change machinery forward.  View
-change processing itself is a stub in the reference (core/message-
-handling.go:419 "Not implemented"), so only the demand/advance edges are
-exercised here too.
+Tracks the current and expected view under an async RW discipline:
+``hold_view_lease`` is the read-lease held across view-sensitive
+processing (the reference takes a read lock and returns a release
+closure, view-state.go:50-74) — message processing that suspends between
+the view check and apply cannot be overtaken by a view advancement;
+``advance_current_view`` takes the write side and waits out active
+leases.  View change processing itself is a stub in the reference
+(core/message-handling.go:419 "Not implemented"), so only the
+demand/advance edges are exercised here too.
 """
 
 from __future__ import annotations
 
 import asyncio
+from contextlib import asynccontextmanager
 from typing import Tuple
 
 
@@ -20,15 +23,33 @@ class ViewState:
         self._current = 0
         self._expected = 0
         self._lock = asyncio.Lock()
+        self._readers = 0
+        self._no_readers = asyncio.Event()
+        self._no_readers.set()
 
     async def hold_view(self) -> Tuple[int, int]:
-        """-> (current_view, expected_view) snapshot.
-
-        The asyncio engine processes view-sensitive steps on one loop, so a
-        snapshot (not a held lock) is sufficient; mutators are serialized
-        with the internal lock."""
+        """-> (current_view, expected_view) snapshot (no lease).  For
+        view-sensitive *processing*, use :meth:`hold_view_lease` — a
+        snapshot can go stale across an await."""
         async with self._lock:
             return self._current, self._expected
+
+    @asynccontextmanager
+    async def hold_view_lease(self):
+        """Read-lease: yields (current, expected); the current view cannot
+        advance until every active lease is released (reference HoldView's
+        RLock, view-state.go:50-74).  Leases are shared — concurrent
+        message processing proceeds in parallel."""
+        async with self._lock:  # writers hold _lock while draining readers,
+            self._readers += 1  # which blocks new leases (writer priority)
+            self._no_readers.clear()
+            cur, exp = self._current, self._expected
+        try:
+            yield cur, exp
+        finally:
+            self._readers -= 1
+            if self._readers == 0:
+                self._no_readers.set()
 
     async def advance_expected_view(self, view: int) -> bool:
         """Demand a view change to ``view``; False if not ahead
@@ -41,8 +62,11 @@ class ViewState:
 
     async def advance_current_view(self, view: int) -> bool:
         """Enter ``view`` (completes a view change; reference
-        view-state.go:90-105)."""
+        view-state.go:90-105).  Waits for in-flight read leases, so a
+        message mid-apply in the old view finishes before the view moves."""
         async with self._lock:
+            while self._readers:
+                await self._no_readers.wait()
             if view <= self._current or view > self._expected:
                 return False
             self._current = view

@@ -40,6 +40,7 @@ from ..messages import (
     stringify,
     unmarshal,
 )
+from ..messages.codec import CodecError
 from . import commit as commit_mod
 from . import prepare as prepare_mod
 from . import request as request_mod
@@ -423,18 +424,21 @@ class Handlers:
         if not await self.capture_ui(msg):
             return False  # already processed (replay)
 
-        # View check (reference processViewMessage,
-        # core/message-handling.go:492-533).
-        view, _ = await self.view_state.hold_view()
-        msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
-        if msg_view != view:
-            return False
+        # View check + apply under one read lease (reference
+        # processViewMessage holds the view, core/message-handling.go:
+        # 492-533): apply suspends at awaits, and without the lease a view
+        # advancement could interleave — a message checked in view v must
+        # not apply in view v+1.
+        async with self.view_state.hold_view_lease() as (view, _):
+            msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
+            if msg_view != view:
+                return False
 
-        if isinstance(msg, Prepare):
-            await self.apply_prepare(msg)
-        else:
-            await self.apply_commit(msg)
-        return True
+            if isinstance(msg, Prepare):
+                await self.apply_prepare(msg)
+            else:
+                await self.apply_commit(msg)
+            return True
 
     # ------------------------------------------------------------------
     # Top-level handlers (reference handleClientMessage / handlePeerMessage /
@@ -458,7 +462,25 @@ class Handlers:
     async def handle_peer_message(self, msg: Message) -> None:
         if isinstance(msg, (Prepare, Commit, ReqViewChange, Request)):
             self.metrics.inc("messages_handled")
-            await self.validate_message(msg)
+            try:
+                await self.validate_message(msg)
+            except api.EmbeddedRequestAuthError:
+                # A UI-certified proposal embeds a request this replica
+                # cannot authenticate (MAC asymmetry / faulty client or
+                # primary).  The primary's counter has moved past a
+                # message we will never accept, so every later message
+                # from it would park on the gap — demand a view change
+                # instead of wedging (view-change *processing* is still
+                # reference-parity unimplemented; the demand is the
+                # fault-evidence signal, like a request timeout).
+                view = (
+                    msg.view
+                    if isinstance(msg, Prepare)
+                    else msg.prepare.view if isinstance(msg, Commit) else None
+                )
+                if view is not None:
+                    await self.request_view_change(view + 1)
+                raise
             await self.process_message(msg)
         else:
             raise api.AuthenticationError(
@@ -492,6 +514,10 @@ def _wire_bytes(msg: Message) -> bytes:
 # stalls the pipeline, small enough to bound memory under a message flood.
 _STREAM_CONCURRENCY = 1024
 
+# A run of this many consecutive NON-authentication processing failures on
+# one peer stream closes the connection (see run_peer_connection).
+_MAX_CONSECUTIVE_INTERNAL_ERRORS = 32
+
 
 class _ConcurrentStreamProcessor:
     """Handle each incoming message in its own task.
@@ -506,9 +532,10 @@ class _ConcurrentStreamProcessor:
     (clientstate), exactly the batching-vs-ordering split of SURVEY.md §7.
     """
 
-    def __init__(self, handle, on_error):
+    def __init__(self, handle, on_error, on_success=None):
         self._handle = handle
         self._on_error = on_error
+        self._on_success = on_success
         self._sem = asyncio.Semaphore(_STREAM_CONCURRENCY)
         self._tasks: set = set()
 
@@ -529,6 +556,8 @@ class _ConcurrentStreamProcessor:
             if msg is None:
                 msg = unmarshal(data)
             await self._handle(msg)
+            if self._on_success is not None:
+                self._on_success()
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -709,17 +738,41 @@ async def run_peer_connection(
         # Keep the stream open until shutdown.
         await done.wait()
 
+    # Expected per-message failures (bad tag, malformed bytes) are drops;
+    # anything else is an internal error.  A persistent internal bug must
+    # not degrade into an endless silently-dropping stream — after a run of
+    # consecutive internal errors the connection is torn down loudly (the
+    # pre-concurrency behavior, where one such exception killed the
+    # stream).
+    internal = {"consecutive": 0}
+
     def _drop(e: Exception) -> None:
         handlers.metrics.inc("messages_dropped")
-        if isinstance(e, api.AuthenticationError):
+        if isinstance(e, (api.AuthenticationError, CodecError)):
+            internal["consecutive"] = 0
             handlers.log.warning("peer %d message rejected: %s", peer_id, e)
         else:
+            internal["consecutive"] += 1
             handlers.log.error("peer %d message failed: %r", peer_id, e)
 
-    proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop)
+    def _ok() -> None:
+        # Successful handling breaks an error run — only genuinely
+        # CONSECUTIVE internal failures (a wedged handler) tear the
+        # connection down; sporadic transients never accumulate.
+        internal["consecutive"] = 0
+
+    proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop, _ok)
     try:
         async for data in stream_handler.handle_message_stream(outgoing()):
             if done.is_set():
+                break
+            if internal["consecutive"] >= _MAX_CONSECUTIVE_INTERNAL_ERRORS:
+                handlers.log.error(
+                    "peer %d connection closed: %d consecutive internal "
+                    "processing errors",
+                    peer_id,
+                    internal["consecutive"],
+                )
                 break
             await proc.submit(data)
     except asyncio.CancelledError:

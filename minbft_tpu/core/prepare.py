@@ -34,10 +34,22 @@ def make_prepare_validator(
         # Client signatures on every embedded request + the primary's UI,
         # batched into one engine round (the reference does these serially,
         # prepare.go:55-61).
-        await asyncio.gather(
+        results = await asyncio.gather(
             *[validate_request(r) for r in prepare.requests],
             verify_ui(prepare),
+            return_exceptions=True,
         )
+        ui_exc = results[-1]
+        if isinstance(ui_exc, BaseException):
+            raise ui_exc
+        for exc in results[:-1]:
+            if isinstance(exc, api.AuthenticationError):
+                # UI valid, embedded request not: see
+                # api.EmbeddedRequestAuthError — the handler demands a
+                # view change rather than wedging on the counter gap.
+                raise api.EmbeddedRequestAuthError(str(exc)) from exc
+            if isinstance(exc, BaseException):
+                raise exc
 
     return validate_prepare
 

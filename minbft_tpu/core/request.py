@@ -43,8 +43,10 @@ def make_request_processor(
         if not new:
             return False
         pending_requests.add(request)
-        view, _ = await view_state.hold_view()
-        await apply_request(request, view)
+        # Apply under the view read-lease (the reference holds the view
+        # across applyRequest, request.go:166-175).
+        async with view_state.hold_view_lease() as (view, _):
+            await apply_request(request, view)
         return True
 
     return process_request

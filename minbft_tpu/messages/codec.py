@@ -166,6 +166,17 @@ def marshal(m: Message) -> bytes:
 # *accumulated key bytes*, not entry count: a batched PREPARE's wire bytes
 # are O(batch * request size), so an entry-count cap could retain hundreds
 # of MB.
+#
+# Two documented assumptions (deliberate trade-offs, not invariants):
+# - The cache is populated with PRE-authentication bytes, so a peer or
+#   client flooding distinct REQUEST/PREPARE wire bytes fills the LRU with
+#   junk and evicts the hot legitimate entries.  That degrades the
+#   parse/dedup amortization (perf only — correctness never depends on an
+#   intern hit); interning post-validation would shrink the attack surface
+#   at the cost of the first-parse dedup that the n-replica fan-in relies
+#   on.
+# - Access is assumed single-threaded on one asyncio event loop (true for
+#   grpc.aio and the in-process connector); the OrderedDict is not locked.
 _INTERN_MAX_BYTES = 32 * 1024 * 1024
 _intern: "OrderedDict[bytes, Message]" = OrderedDict()
 _intern_bytes = 0

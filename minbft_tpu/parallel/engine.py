@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import threading
 import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -192,7 +193,16 @@ class BatchVerifier:
         max_delay: float = 0.0,
         buckets: Optional[Sequence[int]] = None,
         max_inflight: int = 2,
+        mesh=None,
     ):
+        # Multi-chip: pass a jax.sharding.Mesh (parallel.mesh.make_mesh)
+        # and every device dispatch routes through the sharded kernels —
+        # the batch axis is partitioned over the mesh and XLA lays the
+        # per-chip programs out over ICI (BASELINE config[4]'s scaling
+        # axis).  A 1-device mesh degenerates to the single-chip kernels.
+        self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
+        self._sharded_kernels: Dict[str, object] = {}
+        self._sharded_lock = threading.Lock()
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.max_inflight = max_inflight
@@ -219,7 +229,26 @@ class BatchVerifier:
             raise ValueError(
                 f"largest bucket {self.buckets[-1]} < max_batch {max_batch}"
             )
+        if self.mesh is not None:
+            # Sharded kernels need every argument's batch axis divisible
+            # by the mesh size (mesh.py documents the constraint) — round
+            # each bucket up to the next multiple.
+            sz = self.mesh.size
+            self.buckets = tuple(
+                sorted({-(-b // sz) * sz for b in self.buckets})
+            )
         self._queues: Dict[str, _SchemeQueue] = {}
+
+    def _sharded(self, name: str, builder):
+        # Dispatchers run on worker threads (max_inflight > 1): lock the
+        # memo so two concurrent first dispatches don't both trace and
+        # compile the same sharded kernel.
+        with self._sharded_lock:
+            k = self._sharded_kernels.get(name)
+            if k is None:
+                k = builder(self.mesh)
+                self._sharded_kernels[name] = k
+            return k
 
     # -- queues -------------------------------------------------------------
 
@@ -282,6 +311,11 @@ class BatchVerifier:
         b = _bucket_for(n, self.buckets)
         arrays = p256.prepare_batch(list(items) + [_ECDSA_PAD] * (b - n))
         self._queues["ecdsa_p256"].stats.padded_lanes += b - n
+        if self.mesh is not None:
+            from . import mesh as mesh_mod
+
+            kernel = self._sharded("ecdsa", mesh_mod.sharded_ecdsa_kernel)
+            return np.asarray(kernel(*arrays))[:n]
         out = p256.ecdsa_verify_kernel(*[jnp.asarray(a) for a in arrays])
         return np.asarray(out)[:n]
 
@@ -300,6 +334,11 @@ class BatchVerifier:
             msgs[i] = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
             macs[i] = np.frombuffer(mac, dtype=">u4").astype(np.uint32)
         self._queues["hmac_sha256"].stats.padded_lanes += b - n
+        if self.mesh is not None:
+            from . import mesh as mesh_mod
+
+            kernel = self._sharded("hmac", mesh_mod.sharded_hmac_kernel)
+            return np.asarray(kernel(keys, msgs, macs))[:n]
         out = hmac_verify_kernel(
             jnp.asarray(keys), jnp.asarray(msgs), jnp.asarray(macs)
         )
@@ -311,6 +350,12 @@ class BatchVerifier:
         n = len(items)
         b = _bucket_for(n, self.buckets)
         self._queues["ed25519"].stats.padded_lanes += b - n
+        if self.mesh is not None:
+            from . import mesh as mesh_mod
+
+            kernel = self._sharded("ed25519", mesh_mod.sharded_ed25519_kernel)
+            arrays = ed.prepare_batch(list(items), b)
+            return np.asarray(kernel(*arrays))[:n]
         return ed.verify_batch_padded(list(items), b)[:n]
 
     # Host dispatchers: serial OpenSSL in the worker thread — no padding,

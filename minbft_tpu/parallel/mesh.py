@@ -69,16 +69,20 @@ def sharded_verifier(scalar_verify: Callable, mesh: Mesh, n_args: int):
             out_shardings=sh,
         )
 
+    import threading
+
     cache = {}
+    lock = threading.Lock()  # callers dispatch from worker threads
 
     def wrapper(*args):
         from ..ops import lowering
 
         m = lowering.mode()
-        fn = cache.get(m)
-        if fn is None:
-            fn = build()
-            cache[m] = fn
+        with lock:
+            fn = cache.get(m)
+            if fn is None:
+                fn = build()
+                cache[m] = fn
         return fn(*args)
 
     return wrapper
@@ -97,6 +101,14 @@ def sharded_hmac_kernel(mesh: Mesh):
     from ..ops.hmac_sha256 import hmac32_verify
 
     return sharded_verifier(hmac32_verify, mesh, 3)
+
+
+def sharded_ed25519_kernel(mesh: Mesh):
+    """Batched Ed25519 verify sharded across ``mesh`` (7 limb-array
+    arguments, see :func:`minbft_tpu.ops.ed25519.prepare_batch`)."""
+    from ..ops import ed25519 as ed
+
+    return sharded_verifier(ed._verify_one, mesh, 7)
 
 
 def sharded_ecdsa_sign_kernel(mesh: Mesh):

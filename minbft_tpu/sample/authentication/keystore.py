@@ -291,9 +291,20 @@ class KeyStore:
         return store
 
     def save(self, path: str) -> None:
+        """Write keys.yaml with owner-only permissions: the file holds
+        private signature keys, sealed USIG blobs, and (if present) the
+        pairwise MAC matrix.  Deployment flows should distribute
+        per-replica ``strip_private(keep_replica=i)`` copies, not this
+        full store."""
+        import os as _os
+
         import yaml
 
-        with open(path, "w") as fh:
+        fd = _os.open(path, _os.O_CREAT | _os.O_WRONLY | _os.O_TRUNC, 0o600)
+        # O_CREAT's mode only applies to newly-created files; tighten a
+        # pre-existing laxer file too before writing secrets into it.
+        _os.fchmod(fd, 0o600)
+        with _os.fdopen(fd, "w") as fh:
             yaml.safe_dump(self.to_dict(), fh, sort_keys=False)
 
     @classmethod

@@ -23,11 +23,21 @@ engine's device or host queues (with the cluster-wide dedup memo) apply
 unchanged.
 
 Trust caveat (inherent to MAC authenticators, known from PBFT): a faulty
-*client* can craft a vector whose slots verify at some replicas and not
-others, which costs liveness for that request (some backups reject the
-PREPARE embedding it), never safety.  Public-key signatures remain the
-default scheme; MACs trade that robustness for ~100x cheaper
-authentication.
+*client* can craft a vector whose slots verify at the primary but fail at
+a correct backup.  The consequence is worse than losing that one request:
+the backup rejects the whole PREPARE embedding it, so the primary's UI
+counter is never captured there, and **every subsequent message from that
+primary parks on the counter gap** (peerstate in-order capture) until the
+per-stream concurrency bound fills — a liveness stall for the whole
+stream, not one request.  Never safety: no forged request can commit.
+Mitigation wired in core: a backup that sees a UI-valid proposal with a
+bad embedded-request MAC raises
+:class:`minbft_tpu.api.EmbeddedRequestAuthError`, and message handling
+immediately demands a view change to depose the wedged primary (instead
+of waiting for the request timeout); repeated faulty clients can still
+thrash views — public-key signatures remain the default scheme, and MAC
+deployments assume clients are trusted-or-expendable.  MACs trade that
+robustness for ~100x cheaper authentication.
 """
 
 from __future__ import annotations

@@ -313,6 +313,15 @@ def _run_testnet_scaffold(args) -> int:
     )
     keys_path = os.path.join(args.dir, "keys.yaml")
     store.save(keys_path)
+    # Per-replica least-privilege copies: replica i gets only its own
+    # private material (and only its rows of the MAC matrix) — handing the
+    # full store to every node would let one compromised replica forge
+    # other principals' keys/MAC slots.  The full keys.yaml stays for the
+    # operator/client side.  All files are written 0600 (KeyStore.save).
+    for i in range(args.replicas):
+        store.strip_private(keep_replica=i).save(
+            os.path.join(args.dir, f"keys.replica{i}.yaml")
+        )
     peers = [
         {"id": i, "addr": f"{args.host}:{args.base_port + i}"}
         for i in range(args.replicas)

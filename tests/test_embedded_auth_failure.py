@@ -1,0 +1,106 @@
+"""Embedded-request auth asymmetry: a UI-valid proposal carrying a request
+the local replica cannot authenticate must demand a view change instead of
+silently wedging the primary's counter stream (the MAC-scheme liveness
+hazard documented in sample/authentication/mac.py; see
+api.EmbeddedRequestAuthError)."""
+
+import asyncio
+
+import pytest
+
+from conftest import make_cluster
+from minbft_tpu import api
+from minbft_tpu.core.prepare import make_prepare_validator
+from minbft_tpu.messages import Hello, ReqViewChange, marshal
+from minbft_tpu.messages.message import Prepare, Request
+
+
+def test_prepare_validator_distinguishes_embedded_request_failure():
+    """UI valid + embedded request invalid -> EmbeddedRequestAuthError;
+    UI invalid -> plain AuthenticationError (no view-change escalation
+    for a forgeable message)."""
+
+    async def ok(_msg):
+        return None
+
+    async def bad(_msg):
+        raise api.AuthenticationError("nope")
+
+    req = Request(client_id=0, seq=1, operation=b"x", signature=b"s")
+    prep = Prepare(replica_id=0, view=0, requests=[req], ui=None)
+
+    async def run():
+        v = make_prepare_validator(4, validate_request=bad, verify_ui=ok)
+        with pytest.raises(api.EmbeddedRequestAuthError):
+            await v(prep)
+        v = make_prepare_validator(4, validate_request=ok, verify_ui=bad)
+        with pytest.raises(api.AuthenticationError) as ei:
+            await v(prep)
+        assert not isinstance(ei.value, api.EmbeddedRequestAuthError)
+        v = make_prepare_validator(4, validate_request=bad, verify_ui=bad)
+        with pytest.raises(api.AuthenticationError) as ei:
+            await v(prep)
+        assert not isinstance(ei.value, api.EmbeddedRequestAuthError)
+
+    asyncio.run(run())
+
+
+def test_backup_demands_view_change_on_ui_valid_bad_request():
+    """End-to-end: a PREPARE certified by the real primary USIG but
+    embedding a badly-signed request makes the backup demand view 1 and
+    broadcast REQ-VIEW-CHANGE (reference-parity: processing of the demand
+    itself stays unimplemented)."""
+
+    async def run():
+        replicas, _c_auths, stubs, _ledgers = await make_cluster()
+        try:
+            primary = replicas[0].handlers
+            backup = replicas[1].handlers
+
+            forged_req = Request(
+                client_id=0, seq=7, operation=b"evil", signature=b"bad" * 8
+            )
+            prep = Prepare(replica_id=0, view=0, requests=[forged_req], ui=None)
+            primary.assign_ui(prep)  # genuine primary UI over the proposal
+
+            done = asyncio.Event()
+
+            async def outgoing():
+                yield marshal(Hello(replica_id=0))
+                yield marshal(prep)
+                try:
+                    await asyncio.wait_for(done.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    return
+
+            handler = stubs[1].peer_message_stream_handler()
+
+            async def drain():
+                async for _ in handler.handle_message_stream(outgoing()):
+                    pass
+
+            t = asyncio.ensure_future(drain())
+            for _ in range(100):
+                _, expected = await backup.view_state.hold_view()
+                if expected >= 1:
+                    break
+                await asyncio.sleep(0.02)
+            done.set()
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+
+            _, expected = await backup.view_state.hold_view()
+            assert expected == 1, "backup did not demand a view change"
+            # the demand was broadcast as a signed REQ-VIEW-CHANGE
+            assert any(
+                isinstance(m, ReqViewChange) and m.new_view == 1
+                for m in backup.message_log.snapshot()
+            )
+        finally:
+            for r in replicas:
+                await r.stop()
+
+    asyncio.run(run())

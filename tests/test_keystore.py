@@ -112,6 +112,44 @@ def test_strip_private(tmp_path):
     assert public.usig_anchors() == store.usig_anchors()
 
 
+def test_keystore_file_mode_owner_only(tmp_path):
+    """keys.yaml carries private keys/sealed blobs/MAC matrices — save()
+    must create it 0600 (and rewrite any laxer pre-existing file)."""
+    import os
+    import stat
+
+    path = str(tmp_path / "keys.yaml")
+    store = generate_testnet_keys(2, with_macs=True)
+    store.save(path)
+    assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+    KeyStore.load(path)  # still loadable
+    # a pre-existing laxer file is tightened, not inherited
+    os.chmod(path, 0o644)
+    store.save(path)
+    assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+
+
+def test_testnet_scaffold_writes_stripped_per_replica_copies(tmp_path):
+    """The peer testnet scaffold emits least-privilege keys.replicaN.yaml
+    copies: replica i keeps only its own private material."""
+    from minbft_tpu.sample.peer.cli import main as peer_main
+
+    d = str(tmp_path / "net")
+    rc = peer_main(["testnet", "-n", "3", "-d", d, "--usig", "SOFT_ECDSA"])
+    assert rc in (0, None)
+    full = KeyStore.load(f"{d}/keys.yaml")
+    for i in range(3):
+        stripped = KeyStore.load(f"{d}/keys.replica{i}.yaml")
+        # own private material present, others' absent
+        stripped.replica_authenticator(i)
+        for j in range(3):
+            if j != i:
+                with pytest.raises(KeyStoreError):
+                    stripped.replica_authenticator(j)
+        # trust anchors match the full store
+        assert stripped.usig_anchors() == full.usig_anchors()
+
+
 def test_keytool_generate(tmp_path):
     out = str(tmp_path / "k.yaml")
     rc = keytool_main(

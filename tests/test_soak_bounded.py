@@ -113,15 +113,41 @@ def test_clientstate_soak_replies_bounded():
             st.add_reply(seq, ("reply", seq))
             assert st.retire_request_seq(seq)
             await st.release_request_seq(seq)
-        # O(1): no per-seq containers exist anymore
+        # bounded: the reply window never exceeds its cap
         assert st._last_replied_seq == n
-        assert st._reply == ("reply", n)
+        assert len(st._replies) == st._REPLY_WINDOW
         # duplicate-request behavior: a late retry of the LAST request
-        # still gets its reply...
+        # (or anything still in the window) still gets its reply...
         assert await st.reply_for(n) == ("reply", n)
-        # ...and a stale superseded seq yields None (reference
-        # ReplyChannel closes without sending, reply.go:74-79)
+        assert await st.reply_for(n - 5) == ("reply", n - 5)
+        # ...and a stale seq pruned out of the window yields None
+        # (reference ReplyChannel closes without sending, reply.go:74-79)
         assert await st.reply_for(5) is None
+
+    asyncio.run(run())
+
+
+def test_reply_window_survives_pipelined_bursts():
+    """Regression (round-3 deadlock): with a pipelined client, replies k
+    and k+1 can both land BEFORE the waiter for k wakes — a single
+    last-reply slot skips k and strands the waiter forever.  The window
+    must deliver both."""
+
+    async def run():
+        st = ClientState(FakeTimerProvider())
+        got = {}
+
+        async def waiter(seq):
+            got[seq] = await st.reply_for(seq)
+
+        tasks = [asyncio.create_task(waiter(s)) for s in (1, 2, 3)]
+        await asyncio.sleep(0)  # all three waiters parked
+        # burst: all three replies land in one loop turn
+        st.add_reply(1, "r1")
+        st.add_reply(2, "r2")
+        st.add_reply(3, "r3")
+        await asyncio.wait_for(asyncio.gather(*tasks), timeout=2)
+        assert got == {1: "r1", 2: "r2", 3: "r3"}
 
     asyncio.run(run())
 

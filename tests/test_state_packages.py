@@ -273,3 +273,61 @@ def test_viewstate_advance_expected_and_current():
         assert await vs.advance_current_view(2)
 
     asyncio.run(run())
+
+
+def test_viewstate_lease_blocks_view_advancement():
+    """A message mid-apply (holding the read lease across an await) cannot
+    be overtaken by advance_current_view — the reference's read-lock
+    semantics (view-state.go:50-74)."""
+
+    async def run():
+        vs = ViewState()
+        await vs.advance_expected_view(1)
+        gate = asyncio.Event()
+        order = []
+
+        async def processing():
+            async with vs.hold_view_lease() as (view, _):
+                assert view == 0
+                order.append("apply-start")
+                await gate.wait()  # suspended mid-apply
+                # still view 0 from this lease's perspective: the writer
+                # is parked until we release
+                order.append("apply-end")
+
+        async def advancer():
+            order.append("advance-start")
+            assert await vs.advance_current_view(1)
+            order.append("advanced")
+
+        t1 = asyncio.create_task(processing())
+        await asyncio.sleep(0)
+        t2 = asyncio.create_task(advancer())
+        await asyncio.sleep(0.01)
+        assert order == ["apply-start", "advance-start"]  # writer parked
+        gate.set()
+        await asyncio.gather(t1, t2)
+        assert order == ["apply-start", "advance-start", "apply-end", "advanced"]
+        # a message from view 0 now fails the in-lease view check
+        async with vs.hold_view_lease() as (view, _):
+            assert view == 1
+
+    asyncio.run(run())
+
+
+def test_viewstate_concurrent_leases_are_shared():
+    async def run():
+        vs = ViewState()
+        active = {"n": 0, "max": 0}
+
+        async def reader():
+            async with vs.hold_view_lease():
+                active["n"] += 1
+                active["max"] = max(active["max"], active["n"])
+                await asyncio.sleep(0.01)
+                active["n"] -= 1
+
+        await asyncio.gather(*[reader() for _ in range(8)])
+        assert active["max"] > 1  # leases overlap (no reader serialization)
+
+    asyncio.run(run())
