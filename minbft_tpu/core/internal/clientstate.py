@@ -59,6 +59,13 @@ class ClientState:
         Returns False if ``seq`` was already captured (duplicate).  Blocks
         while a prior capture is unreleased (the per-client serialization of
         reference request-seq.go:47-82)."""
+        # Duplicate fast path: ``_last_captured`` only grows, and on the
+        # single-threaded event loop it cannot change between this check
+        # and the return — the condvar is only needed to *capture*.
+        # (Duplicates dominate: every peer message re-offers its embedded
+        # requests.)
+        if seq <= self._last_captured:
+            return False
         async with self._cond:
             while self._last_captured != self._last_released:
                 if seq <= self._last_captured:

@@ -14,60 +14,87 @@ demand/advance edges are exercised here too.
 from __future__ import annotations
 
 import asyncio
-from contextlib import asynccontextmanager
 from typing import Tuple
+
+
+class _Lease:
+    """Shared read-lease handle (``async with view_state.hold_view_lease()``).
+
+    The hot path — no view change draining — takes and releases the lease
+    with plain counter arithmetic, no locks and no context-manager
+    machinery: on the single-threaded event loop nothing can interleave
+    between the writer-gate check and the counter increment.  Only while a
+    writer is draining does entry await the gate (writer priority: new
+    leases queue behind a pending advance)."""
+
+    __slots__ = ("_vs",)
+
+    def __init__(self, vs: "ViewState"):
+        self._vs = vs
+
+    async def __aenter__(self) -> Tuple[int, int]:
+        vs = self._vs
+        while vs._writer_waiting:
+            await vs._write_gate.wait()
+        vs._readers += 1
+        return vs._current, vs._expected
+
+    async def __aexit__(self, *exc) -> bool:
+        vs = self._vs
+        vs._readers -= 1
+        if vs._readers == 0 and vs._writer_waiting:
+            vs._no_readers.set()
+        return False
 
 
 class ViewState:
     def __init__(self):
         self._current = 0
         self._expected = 0
-        self._lock = asyncio.Lock()
+        self._write_lock = asyncio.Lock()  # serializes writers only
         self._readers = 0
+        self._writer_waiting = False
         self._no_readers = asyncio.Event()
-        self._no_readers.set()
+        self._write_gate = asyncio.Event()
+        self._write_gate.set()
 
     async def hold_view(self) -> Tuple[int, int]:
         """-> (current_view, expected_view) snapshot (no lease).  For
         view-sensitive *processing*, use :meth:`hold_view_lease` — a
         snapshot can go stale across an await."""
-        async with self._lock:
-            return self._current, self._expected
+        return self._current, self._expected
 
-    @asynccontextmanager
-    async def hold_view_lease(self):
+    def hold_view_lease(self) -> _Lease:
         """Read-lease: yields (current, expected); the current view cannot
         advance until every active lease is released (reference HoldView's
         RLock, view-state.go:50-74).  Leases are shared — concurrent
         message processing proceeds in parallel."""
-        async with self._lock:  # writers hold _lock while draining readers,
-            self._readers += 1  # which blocks new leases (writer priority)
-            self._no_readers.clear()
-            cur, exp = self._current, self._expected
-        try:
-            yield cur, exp
-        finally:
-            self._readers -= 1
-            if self._readers == 0:
-                self._no_readers.set()
+        return _Lease(self)
 
     async def advance_expected_view(self, view: int) -> bool:
         """Demand a view change to ``view``; False if not ahead
         (reference view-state.go:74-88)."""
-        async with self._lock:
-            if view <= self._expected:
-                return False
-            self._expected = view
-            return True
+        if view <= self._expected:
+            return False
+        self._expected = view
+        return True
 
     async def advance_current_view(self, view: int) -> bool:
         """Enter ``view`` (completes a view change; reference
         view-state.go:90-105).  Waits for in-flight read leases, so a
-        message mid-apply in the old view finishes before the view moves."""
-        async with self._lock:
-            while self._readers:
-                await self._no_readers.wait()
-            if view <= self._current or view > self._expected:
-                return False
-            self._current = view
-            return True
+        message mid-apply in the old view finishes before the view moves;
+        new leases queue behind the drain on the write gate."""
+        async with self._write_lock:
+            self._writer_waiting = True
+            self._write_gate.clear()
+            try:
+                while self._readers:
+                    self._no_readers.clear()
+                    await self._no_readers.wait()
+                if view <= self._current or view > self._expected:
+                    return False
+                self._current = view
+                return True
+            finally:
+                self._writer_waiting = False
+                self._write_gate.set()

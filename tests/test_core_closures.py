@@ -1,0 +1,546 @@
+"""Closure-level unit tests with injected fakes.
+
+The reference tests every ``makeX`` closure constructor in isolation by
+injecting mock closures and enumerating each branch (reference
+core/message-handling_test.go:41-120, core/prepare_test.go,
+core/commit_test.go:112-320, core/request_test.go, core/usig-ui_test.go);
+integration tests alone don't pin the per-branch contracts.  This file is
+that per-closure matrix for the asyncio closure graph: every branch of
+core/{prepare,commit,request,usig_ui}.py is reachable from here without
+spinning up a cluster.
+"""
+
+import asyncio
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.core import commit as commit_mod
+from minbft_tpu.core import prepare as prepare_mod
+from minbft_tpu.core import request as request_mod
+from minbft_tpu.core import usig_ui
+from minbft_tpu.core.internal.clientstate import ClientStates
+from minbft_tpu.messages import UI, Commit, Prepare, Reply, Request
+from minbft_tpu.usig import ui_to_bytes
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _req(client_id=7, seq=1, op=b"op"):
+    return Request(client_id=client_id, seq=seq, operation=op)
+
+
+def _prepare(cv=1, view=0, primary=None, requests=None):
+    primary = view % 4 if primary is None else primary
+    return Prepare(
+        replica_id=primary,
+        view=view,
+        requests=requests or [_req(seq=cv)],
+        ui=UI(counter=cv),
+    )
+
+
+class Calls:
+    """Recording fake: each named closure appends (name, args) and returns /
+    raises what the test configured."""
+
+    def __init__(self):
+        self.log = []
+        self.raises = {}
+        self.returns = {}
+
+    def sync(self, name):
+        def fn(*args):
+            self.log.append((name, args))
+            exc = self.raises.get(name)
+            if exc is not None:
+                raise exc
+            return self.returns.get(name)
+
+        return fn
+
+    def coro(self, name):
+        async def fn(*args):
+            self.log.append((name, args))
+            exc = self.raises.get(name)
+            if exc is not None:
+                raise exc
+            return self.returns.get(name)
+
+        return fn
+
+    def names(self):
+        return [name for name, _ in self.log]
+
+
+# ---------------------------------------------------------------------------
+# prepare.py — make_prepare_validator (reference core/prepare.go:46-65)
+
+
+def test_prepare_validator_rejects_non_primary():
+    c = Calls()
+    validate = prepare_mod.make_prepare_validator(
+        4, c.coro("validate_request"), c.coro("verify_ui")
+    )
+    # view 0 primary is replica 0; a PREPARE claiming replica 2 is refused
+    # before any signature work (reference prepare.go:51-53).
+    bad = _prepare(view=0, primary=2)
+    with pytest.raises(api.AuthenticationError):
+        run(validate(bad))
+    assert c.log == []
+
+
+def test_prepare_validator_checks_requests_and_ui():
+    c = Calls()
+    validate = prepare_mod.make_prepare_validator(
+        4, c.coro("validate_request"), c.coro("verify_ui")
+    )
+    reqs = [_req(seq=1), _req(seq=2)]
+    run(validate(_prepare(requests=reqs)))
+    assert sorted(c.names()) == [
+        "validate_request",
+        "validate_request",
+        "verify_ui",
+    ]
+    checked = [a[0] for n, a in c.log if n == "validate_request"]
+    assert checked == reqs
+
+
+def test_prepare_validator_embedded_request_failure_is_typed():
+    # A UI-valid PREPARE embedding an unverifiable request raises the
+    # *typed* error so the handler can demand a view change instead of
+    # wedging on the primary's counter gap (see
+    # message_handling.handle_peer_message).
+    c = Calls()
+    c.raises["validate_request"] = api.AuthenticationError("bad client sig")
+    validate = prepare_mod.make_prepare_validator(
+        4, c.coro("validate_request"), c.coro("verify_ui")
+    )
+    with pytest.raises(api.EmbeddedRequestAuthError):
+        run(validate(_prepare()))
+
+
+def test_prepare_validator_ui_failure_wins_over_request_failure():
+    # If the UI itself is bad the message is simply unauthenticated —
+    # plain AuthenticationError, not the embedded-request escalation.
+    c = Calls()
+    c.raises["validate_request"] = api.AuthenticationError("bad client sig")
+    c.raises["verify_ui"] = api.AuthenticationError("bad UI")
+    validate = prepare_mod.make_prepare_validator(
+        4, c.coro("validate_request"), c.coro("verify_ui")
+    )
+    with pytest.raises(api.AuthenticationError) as ei:
+        run(validate(_prepare()))
+    assert not isinstance(ei.value, api.EmbeddedRequestAuthError)
+    assert "bad UI" in str(ei.value)
+
+
+def test_prepare_validator_internal_error_passes_through():
+    c = Calls()
+    c.raises["validate_request"] = RuntimeError("boom")
+    validate = prepare_mod.make_prepare_validator(
+        4, c.coro("validate_request"), c.coro("verify_ui")
+    )
+    with pytest.raises(RuntimeError):
+        run(validate(_prepare()))
+
+
+# ---------------------------------------------------------------------------
+# prepare.py — make_prepare_applier (reference core/prepare.go:69-94)
+
+
+def _applier(c, replica_id):
+    return prepare_mod.make_prepare_applier(
+        replica_id,
+        c.sync("prepare_seq"),
+        c.coro("collect_commitment"),
+        c.coro("handle_generated"),
+        c.sync("stop_prepare_timer"),
+    )
+
+
+def test_prepare_applier_backup_emits_commit():
+    c = Calls()
+    apply = _applier(c, replica_id=1)  # backup
+    p = _prepare(requests=[_req(seq=1), _req(seq=2)])
+    run(apply(p))
+    # every embedded request marked prepared + timer stopped, commitment
+    # collected for the primary, then an own COMMIT
+    assert c.names() == [
+        "prepare_seq",
+        "stop_prepare_timer",
+        "prepare_seq",
+        "stop_prepare_timer",
+        "collect_commitment",
+        "handle_generated",
+    ]
+    (gen,) = c.log[-1][1]
+    assert isinstance(gen, Commit) and gen.replica_id == 1 and gen.prepare is p
+    assert c.log[-2][1] == (p.replica_id, p)
+
+
+def test_prepare_applier_own_prepare_no_commit():
+    # The primary processes its own PREPARE from the log replay — it counts
+    # the commitment but must not commit to itself (reference
+    # prepare.go:86-90 guards on ownership).
+    c = Calls()
+    apply = _applier(c, replica_id=0)  # == prepare.replica_id
+    run(apply(_prepare()))
+    assert "handle_generated" not in c.names()
+    assert "collect_commitment" in c.names()
+
+
+# ---------------------------------------------------------------------------
+# commit.py — make_commit_validator (reference core/commit.go:74-92)
+
+
+def test_commit_validator_rejects_primary_committer():
+    c = Calls()
+    validate = commit_mod.make_commit_validator(
+        4, c.coro("validate_prepare"), c.coro("verify_ui")
+    )
+    commit = Commit(replica_id=0, prepare=_prepare(view=0, primary=0))
+    with pytest.raises(api.AuthenticationError):
+        run(validate(commit))
+    assert c.log == []
+
+
+def test_commit_validator_validates_prepare_then_ui():
+    c = Calls()
+    validate = commit_mod.make_commit_validator(
+        4, c.coro("validate_prepare"), c.coro("verify_ui")
+    )
+    p = _prepare()
+    commit = Commit(replica_id=2, prepare=p)
+    run(validate(commit))
+    assert c.log == [("validate_prepare", (p,)), ("verify_ui", (commit,))]
+
+
+def test_commit_validator_prepare_failure_short_circuits():
+    c = Calls()
+    c.raises["validate_prepare"] = api.AuthenticationError("bad prepare")
+    validate = commit_mod.make_commit_validator(
+        4, c.coro("validate_prepare"), c.coro("verify_ui")
+    )
+    with pytest.raises(api.AuthenticationError):
+        run(validate(Commit(replica_id=2, prepare=_prepare())))
+    assert "verify_ui" not in c.names()
+
+
+def test_commit_applier_delegates():
+    c = Calls()
+    apply = commit_mod.make_commit_applier(c.coro("collect"))
+    p = _prepare()
+    run(apply(Commit(replica_id=3, prepare=p)))
+    assert c.log == [("collect", (3, p))]
+
+
+# ---------------------------------------------------------------------------
+# commit.py — CommitmentCollector branches not covered by test_commit.py
+# (reference core/commit_test.go:112-320)
+
+
+def test_collector_view_transitions():
+    async def scenario():
+        executed = []
+
+        async def execute(request):
+            executed.append((request.seq))
+
+        col = commit_mod.CommitmentCollector(1, execute)
+        # view 1 commitment accepted (CV numbering starts at 1 per view)
+        await col.collect(1, _prepare(cv=1, view=1, primary=1))
+        # stale view-0 commitment from the same replica is ignored, even
+        # with a CV that would otherwise be a skip
+        await col.collect(1, _prepare(cv=9, view=0, primary=0))
+        # view 2: CV numbering restarts at 1; a later view resets `last`
+        await col.collect(1, _prepare(cv=1, view=2, primary=2))
+        return executed
+
+    assert run(scenario()) == []
+
+
+def test_collector_counter_view_reset_and_straggler():
+    async def scenario():
+        executed = []
+
+        async def execute(request):
+            executed.append(request.seq)
+
+        col = commit_mod.CommitmentCollector(1, execute)  # quorum = 2
+        # full quorum in view 1
+        await col.collect(1, _prepare(cv=1, view=1, primary=1))
+        await col.collect(2, _prepare(cv=1, view=1, primary=1))
+        assert executed == [1]
+        # straggler for the released CV must not re-execute
+        await col.collect(3, _prepare(cv=1, view=1, primary=1))
+        assert executed == [1]
+        # view 2 resets the counter: a fresh quorum at CV 1 executes again
+        await col.collect(1, _prepare(cv=1, view=2, primary=2))
+        await col.collect(2, _prepare(cv=1, view=2, primary=2))
+        return executed
+
+    assert run(scenario()) == [1, 1]
+
+
+def test_collector_batched_prepare_executes_in_batch_order():
+    async def scenario():
+        executed = []
+
+        async def execute(request):
+            executed.append(request.seq)
+
+        col = commit_mod.CommitmentCollector(1, execute)
+        reqs = [_req(client_id=1, seq=4), _req(client_id=2, seq=9)]
+        p = Prepare(replica_id=0, view=0, requests=reqs, ui=UI(counter=1))
+        await col.collect(0, p)
+        await col.collect(1, p)
+        return executed
+
+    assert run(scenario()) == [4, 9]
+
+
+# ---------------------------------------------------------------------------
+# request.py closures (reference core/request.go:146-276)
+
+
+def test_request_validator_delegates():
+    c = Calls()
+    validate = request_mod.make_request_validator(c.coro("verify"))
+    r = _req()
+    run(validate(r))
+    assert c.log == [("verify", (r,))]
+
+
+class _FakeViewState:
+    def __init__(self, view=0):
+        self.view = view
+
+    def hold_view_lease(self):
+        import contextlib
+
+        @contextlib.asynccontextmanager
+        async def lease():
+            yield (self.view, self.view)
+
+        return lease()
+
+
+class _FakePending:
+    def __init__(self):
+        self.added = []
+        self.removed = []
+
+    def add(self, req):
+        self.added.append(req)
+
+    def remove(self, req):
+        self.removed.append(req)
+
+
+def test_request_processor_duplicate_seq_skips_apply():
+    c = Calls()
+    c.returns["capture_seq"] = False
+    pending = _FakePending()
+    process = request_mod.make_request_processor(
+        c.coro("capture_seq"), pending, _FakeViewState(), c.coro("apply")
+    )
+    assert run(process(_req())) is False
+    assert pending.added == [] and "apply" not in c.names()
+
+
+def test_request_processor_new_seq_applies_under_view():
+    c = Calls()
+    c.returns["capture_seq"] = True
+    pending = _FakePending()
+    r = _req()
+    process = request_mod.make_request_processor(
+        c.coro("capture_seq"), pending, _FakeViewState(view=3), c.coro("apply")
+    )
+    assert run(process(r)) is True
+    assert pending.added == [r]
+    assert c.log[-1] == ("apply", (r, 3))
+
+
+def test_request_applier_primary_proposes():
+    c = Calls()
+    apply = request_mod.make_request_applier(
+        0, 4, c.coro("propose"), c.sync("prepare_timer"), c.sync("request_timer")
+    )
+    r = _req()
+    run(apply(r, 0))  # view 0 -> replica 0 is primary
+    assert "propose" in c.names() and "prepare_timer" not in c.names()
+    assert ("request_timer", (r, 0)) in c.log
+
+
+def test_request_applier_backup_starts_prepare_timer():
+    c = Calls()
+    apply = request_mod.make_request_applier(
+        1, 4, c.coro("propose"), c.sync("prepare_timer"), c.sync("request_timer")
+    )
+    r = _req()
+    run(apply(r, 0))  # view 0 -> replica 1 is a backup
+    assert "propose" not in c.names()
+    assert ("prepare_timer", (r, 0)) in c.log
+    assert ("request_timer", (r, 0)) in c.log
+
+
+def test_request_executor_full_path_and_dedup():
+    async def scenario():
+        c = Calls()
+        pending = _FakePending()
+        delivered = []
+        replies = []
+
+        class Consumer:
+            async def deliver(self, op):
+                delivered.append(op)
+                return b"result:" + op
+
+            def state_digest(self):
+                return b""
+
+        retired = {"n": 0}
+
+        def retire(req):
+            retired["n"] += 1
+            return retired["n"] == 1  # second call = duplicate
+
+        execute = request_mod.make_request_executor(
+            5,
+            retire,
+            pending,
+            c.sync("stop_timers"),
+            Consumer(),
+            c.sync("sign"),
+            replies.append,
+        )
+        r = _req(client_id=9, seq=4)
+        await execute(r)
+        await execute(r)  # duplicate: retire_seq false -> no effects
+        return c, pending, delivered, replies, r
+
+    c, pending, delivered, replies, r = run(scenario())
+    assert delivered == [b"op"]
+    assert pending.removed == [r]
+    (reply,) = replies
+    assert isinstance(reply, Reply)
+    assert (reply.replica_id, reply.client_id, reply.seq) == (5, 9, 4)
+    assert reply.result == b"result:op"
+    assert c.names() == ["stop_timers", "sign"]
+
+
+def test_request_replier_returns_buffered_reply():
+    async def scenario():
+        states = ClientStates()
+        r = _req(client_id=3, seq=1)
+        reply = Reply(replica_id=0, client_id=3, seq=1, result=b"ok")
+        states.client(3).add_reply(1, reply)
+        reply_req = request_mod.make_request_replier(states)
+        return await reply_req(r)
+
+    assert run(scenario()).result == b"ok"
+
+
+def test_seq_closures_delegate_to_clientstate():
+    async def scenario():
+        states = ClientStates()
+        capture = request_mod.make_seq_capturer(states)
+        release = request_mod.make_seq_releaser(states)
+        prep = request_mod.make_seq_preparer(states)
+        retire = request_mod.make_seq_retirer(states)
+        r = _req(client_id=2, seq=1)
+        assert await capture(r) is True
+        assert await capture(_req(client_id=2, seq=1)) is False  # dup
+        await release(r)
+        prep(r)
+        assert retire(r) is True
+        assert retire(r) is False  # already retired
+        return True
+
+    assert run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# usig_ui.py (reference core/usig-ui.go:37-91)
+
+
+class _FakeAuth(api.Authenticator):
+    def __init__(self):
+        self.verified = []
+        self.fail = None
+        self.counter = 0
+
+    def generate_message_authen_tag(self, role, data, audience=-1):
+        self.counter += 1
+        return ui_to_bytes(UI(counter=self.counter, cert=b"cert"))
+
+    async def verify_message_authen_tag(self, role, peer_id, data, tag):
+        self.verified.append((role, peer_id, data, tag))
+        if self.fail is not None:
+            raise self.fail
+
+
+def test_ui_verifier_branches():
+    async def scenario():
+        auth = _FakeAuth()
+        verify = usig_ui.make_ui_verifier(auth)
+        p = _prepare()
+
+        # missing UI
+        p_missing = _prepare()
+        p_missing.ui = None
+        with pytest.raises(api.AuthenticationError):
+            await verify(p_missing)
+        # zero counter (reference core/usig-ui.go:65-67)
+        p_zero = _prepare()
+        p_zero.ui = UI(counter=0, cert=b"c")
+        with pytest.raises(api.AuthenticationError):
+            await verify(p_zero)
+        assert auth.verified == []  # rejected before any crypto
+
+        ui = await verify(p)
+        assert ui is p.ui
+        role, peer, _, tag = auth.verified[0]
+        assert role is api.AuthenticationRole.USIG
+        assert peer == p.replica_id
+        assert tag == ui_to_bytes(p.ui)
+
+        auth.fail = api.AuthenticationError("bad")
+        with pytest.raises(api.AuthenticationError):
+            await verify(p)
+        return True
+
+    assert run(scenario())
+
+
+def test_ui_assigner_attaches_ui():
+    auth = _FakeAuth()
+    assign = usig_ui.make_ui_assigner(auth)
+    p = _prepare()
+    p.ui = None
+    assign(p)
+    assert p.ui.counter == 1
+    assign(p)
+    assert p.ui.counter == 2  # fresh tag every call
+
+
+def test_ui_capturer_in_order_once_only():
+    async def scenario():
+        from minbft_tpu.core.internal.peerstate import PeerStates
+
+        capture = usig_ui.make_ui_capturer(PeerStates())
+        first = _prepare(cv=1)
+        assert await capture(first) is True
+        assert await capture(first) is False  # replay
+        # CV 3 must wait for CV 2: parks until 2 is captured
+        waiter = asyncio.ensure_future(capture(_prepare(cv=3)))
+        await asyncio.sleep(0)
+        assert not waiter.done()
+        assert await capture(_prepare(cv=2)) is True
+        assert await waiter is True
+        return True
+
+    assert run(scenario())
