@@ -253,13 +253,26 @@ class Handlers:
         # Failures are never recorded.
         vtoken = self._validation_token = object()
 
-        def _mark(msg) -> bool:
-            """True if this Handlers already validated ``msg``."""
-            done = msg.__dict__.get("_validated_by")
+        # One marking idiom for every per-Handlers memo on interned message
+        # objects (validation below, embedded processing in
+        # _process_peer_message): the attribute holds a set of Handlers
+        # tokens, never replica ids — see the keying rationale above.
+        def _marked(msg, attr: str) -> bool:
+            done = msg.__dict__.get(attr)
             return done is not None and vtoken in done
 
+        def _set_mark(msg, attr: str) -> None:
+            msg.__dict__.setdefault(attr, set()).add(vtoken)
+
+        self._marked = _marked
+        self._set_mark = _set_mark
+
+        def _mark(msg) -> bool:
+            """True if this Handlers already validated ``msg``."""
+            return _marked(msg, "_validated_by")
+
         def _record(msg) -> None:
-            msg.__dict__.setdefault("_validated_by", set()).add(vtoken)
+            _set_mark(msg, "_validated_by")
 
         def _cached_validator(base):
             async def validate_cached(msg) -> None:
@@ -423,13 +436,10 @@ class Handlers:
         # validation marker — interned objects are process-global) and
         # later carriers of the same PREPARE skip straight to UI capture.
         if isinstance(msg, Prepare):
-            done = msg.__dict__.get("_embedded_processed")
-            if done is None or self._validation_token not in done:
+            if not self._marked(msg, "_embedded_processed"):
                 for req in msg.requests:
                     await self.process_request(req)
-                msg.__dict__.setdefault("_embedded_processed", set()).add(
-                    self._validation_token
-                )
+                self._set_mark(msg, "_embedded_processed")
         elif isinstance(msg, Commit):
             await self._process_peer_message(msg.prepare)
 

@@ -1,0 +1,103 @@
+"""Deployment-artifact tests (VERDICT r2 #9): the deploy/ scripts are
+executed, not just shipped.
+
+- ``deploy/local_testnet.sh`` runs for real: replica processes over gRPC
+  sockets, a request committed through them (the reference documents this
+  flow manually, README.md:411-458).
+- The docker-compose stack can't run inside CI (no dockerd), so its parts
+  are checked for consistency and the entrypoint's shared-scaffold lock
+  pattern (reference sample/docker/docker-entrypoint.sh) is executed
+  directly with two racing instances.
+"""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def _env():
+    return dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+
+
+def test_local_testnet_script_commits(tmp_path):
+    res = subprocess.run(
+        ["bash", os.path.join(DEPLOY, "local_testnet.sh"), "3", str(tmp_path)],
+        env=_env(), capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "testnet OK" in res.stdout
+    # the request subcommand printed the committed block digest
+    digests = [l for l in res.stdout.splitlines() if len(l.strip()) == 64]
+    assert digests, res.stdout
+
+
+def test_docker_artifacts_consistent():
+    """The compose stack's pieces agree with each other and with the
+    entrypoint's hostname-rewrite convention."""
+    for script in ("docker-entrypoint.sh", "local_testnet.sh"):
+        path = os.path.join(DEPLOY, script)
+        shell = "bash" if script == "local_testnet.sh" else "sh"
+        res = subprocess.run([shell, "-n", path], capture_output=True, text=True)
+        assert res.returncode == 0, f"{script}: {res.stderr}"
+
+    compose = yaml.safe_load(open(os.path.join(DEPLOY, "docker-compose.yml")))
+    services = compose["services"]
+    # the entrypoint rewrites peers[] to replica%d hostnames — the compose
+    # service names must match that convention
+    replica_services = sorted(s for s in services if s.startswith("replica"))
+    assert replica_services == ["replica0", "replica1", "replica2"]
+    for name in replica_services:
+        build = services[name].get("build", {})
+        context = os.path.normpath(
+            os.path.join(DEPLOY, build.get("context", "."))
+        )
+        dockerfile = build.get("dockerfile", "Dockerfile")
+        assert os.path.exists(os.path.join(context, dockerfile))
+    assert os.path.exists(os.path.join(DEPLOY, "docker-entrypoint.sh"))
+    dockerfile_text = open(os.path.join(DEPLOY, "Dockerfile")).read()
+    assert "docker-entrypoint.sh" in dockerfile_text
+
+
+def test_entrypoint_scaffold_lock(tmp_path):
+    """Execute the entrypoint's once-only scaffold under contention: two
+    racing instances, one scaffolds, both proceed; the lock directory is
+    gone afterwards and the peers are rewritten to service hostnames.
+
+    The only modification to the script under test is the data directory
+    (/data is the container volume; tests must stay inside the repo/tmp).
+    """
+    script = open(os.path.join(DEPLOY, "docker-entrypoint.sh")).read()
+    assert "cd /data" in script
+    ported = script.replace("cd /data", f'cd "{tmp_path}"')
+    script_path = tmp_path / "entrypoint-under-test.sh"
+    script_path.write_text(ported)
+
+    procs = [
+        subprocess.Popen(
+            ["sh", str(script_path), "--help"],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for _ in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err.decode()
+        assert b"usage" in out.lower() or b"usage" in err.lower()
+
+    cfg = yaml.safe_load(open(tmp_path / "consensus.yaml"))
+    assert [p["addr"] for p in cfg["peers"]] == [
+        f"replica{i}:{42610 + i}" for i in range(3)
+    ]
+    # per-replica stripped keystores written; shared lock released
+    for i in range(3):
+        assert (tmp_path / f"keys.replica{i}.yaml").exists()
+    assert not (tmp_path / ".scaffold.lock").exists()
