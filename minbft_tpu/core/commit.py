@@ -90,6 +90,27 @@ class CommitmentCollector:
         # plus quorum-complete prepares awaiting in-order release
         self._next_exec_cv: Dict[int, int] = {}
         self._ready: Dict[Tuple[int, int], Prepare] = {}
+        # per-view primary-CV base: view v's PREPARE counters continue from
+        # the primary's USIG counter, which for v > 0 is wherever its
+        # NEW-VIEW left it (the view-change protocol registers it); view 0
+        # starts at 0 (counters begin at 1).
+        self._view_base: Dict[int, int] = {0: 0}
+
+    def set_view_base(self, view: int, base_cv: int) -> None:
+        """Register the primary-CV base for ``view`` (the NEW-VIEW's own
+        counter): the view's first PREPARE must carry base_cv + 1.  Called
+        by the view-change applier before the view activates.  Never
+        trimmed here — a size-based eviction could drop the *current*
+        view's base while its lease-holders are still applying (contested
+        escalations register several candidate views before one wins);
+        :meth:`prune_view_bases` retires concluded views instead."""
+        self._view_base[view] = base_cv
+
+    def prune_view_bases(self, active_view: int) -> None:
+        """Drop bases of views below ``active_view`` — their messages are
+        refused by the view check anyway.  Called after a view activates."""
+        for v in [v for v in self._view_base if v < active_view]:
+            del self._view_base[v]
 
     def _count(self, view: int, primary_cv: int) -> bool:
         """Reference makeCommitmentCounter (commit.go:177-201): True when
@@ -114,11 +135,12 @@ class CommitmentCollector:
         view = prepare.view
         primary_cv = prepare.ui.counter
         async with self._lock:
-            cur_view, last = self._accepted.get(replica_id, (view, 0))
+            base = self._view_base.get(view, 0)
+            cur_view, last = self._accepted.get(replica_id, (view, base))
             if view < cur_view:
                 return  # commitment from an abandoned view
             if view > cur_view:
-                last = 0  # new view: CV numbering restarts
+                last = base  # new view: CV numbering restarts from its base
             if primary_cv <= last:
                 return  # replayed commitment — already accounted
             if primary_cv != last + 1:
@@ -134,7 +156,10 @@ class CommitmentCollector:
             # The counter may report done again for stragglers of an
             # already-released quorum (it has no per-CV memory); the
             # in-order release watermark is the dedup.
-            if primary_cv < self._next_exec_cv.get(view, 1) or ckey in self._ready:
+            if (
+                primary_cv < self._next_exec_cv.get(view, base + 1)
+                or ckey in self._ready
+            ):
                 return
             self._ready[ckey] = prepare
         await self._drain(view)
@@ -150,7 +175,9 @@ class CommitmentCollector:
         async with self._exec_lock:
             while True:
                 async with self._lock:
-                    nxt = self._next_exec_cv.setdefault(view, 1)
+                    nxt = self._next_exec_cv.setdefault(
+                        view, self._view_base.get(view, 0) + 1
+                    )
                     prepare = self._ready.pop((view, nxt), None)
                     if prepare is not None:
                         self._next_exec_cv[view] = nxt + 1

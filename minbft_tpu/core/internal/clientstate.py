@@ -169,6 +169,12 @@ class ClientStates:
         self._timers = timer_provider or StandardTimerProvider()
         self._clients: Dict[int, ClientState] = {}
 
+    @property
+    def timers(self) -> TimerProvider:
+        """The injected timer provider (shared with replica-level timers
+        like the view-change timer, so fake-timer tests control both)."""
+        return self._timers
+
     def client(self, client_id: int) -> ClientState:
         st = self._clients.get(client_id)
         if st is None:

@@ -6,9 +6,10 @@ processing (the reference takes a read lock and returns a release
 closure, view-state.go:50-74) — message processing that suspends between
 the view check and apply cannot be overtaken by a view advancement;
 ``advance_current_view`` takes the write side and waits out active
-leases.  View change processing itself is a stub in the reference
-(core/message-handling.go:419 "Not implemented"), so only the
-demand/advance edges are exercised here too.
+leases.  The reference never advances the current view (its view-change
+processing is a stub, core/message-handling.go:419); here the full
+view-change protocol (core/viewchange.py) drives every edge, including
+``wait_current_at_least`` for messages from views still being entered.
 """
 
 from __future__ import annotations
@@ -57,6 +58,18 @@ class ViewState:
         self._no_readers = asyncio.Event()
         self._write_gate = asyncio.Event()
         self._write_gate.set()
+        self._advanced = asyncio.Event()  # swapped on every current-advance
+
+    async def wait_current_at_least(self, view: int) -> None:
+        """Park until the current view reaches ``view`` — how processing of
+        a message from a *future* view waits for the local view transition
+        to catch up instead of dropping it (the reference errors such
+        messages out, core/message-handling.go "unexpected view")."""
+        while True:
+            ev = self._advanced  # capture BEFORE the check: an advance
+            if self._current >= view:  # between check and wait() sets the
+                return  # captured event, so the wakeup cannot be missed
+            await ev.wait()
 
     async def hold_view(self) -> Tuple[int, int]:
         """-> (current_view, expected_view) snapshot (no lease).  For
@@ -94,6 +107,8 @@ class ViewState:
                 if view <= self._current or view > self._expected:
                     return False
                 self._current = view
+                ev, self._advanced = self._advanced, asyncio.Event()
+                ev.set()
                 return True
             finally:
                 self._writer_waiting = False

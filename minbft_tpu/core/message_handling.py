@@ -31,10 +31,12 @@ from ..messages import (
     Commit,
     Hello,
     Message,
+    NewView,
     Prepare,
     ReqViewChange,
     Reply,
     Request,
+    ViewChange,
     authen_bytes,
     marshal,
     stringify,
@@ -46,6 +48,7 @@ from . import prepare as prepare_mod
 from . import request as request_mod
 from . import timeout as timeout_mod
 from . import usig_ui, utils
+from . import viewchange as viewchange_mod
 from ..utils.metrics import ReplicaMetrics
 from .internal.clientstate import ClientStates
 from .internal.messagelog import MessageLog
@@ -70,16 +73,42 @@ class _PrepareBatcher:
         self.max_batch = max(1, max_batch)
         self._handle_generated = handle_generated
         self._buffers: Dict[int, list] = {}  # view -> pending requests
+        self._suspended = 0
 
     async def propose(self, request: Request, view: int) -> None:
         buf = self._buffers.setdefault(view, [])
         buf.append(request)
+        if self._suspended:
+            return  # resume() flushes
         if len(buf) >= self.max_batch:
             self._flush(view)
         elif len(buf) == 1:
             asyncio.get_running_loop().call_soon(self._flush, view)
 
+    def suspend(self) -> None:
+        """Hold flushes — the view-change applier suspends proposals so the
+        new view's re-proposals (S) are certified *before* any fresh
+        request, then resumes.  Counted: concurrent transitions nest."""
+        self._suspended += 1
+
+    def resume(self, active_view: int) -> None:
+        self._suspended -= 1
+        if self._suspended:
+            return
+        for view in list(self._buffers):
+            if view < active_view:
+                # Abandoned-view proposals must not waste USIG counters
+                # (a stale flush when this replica is primary again in
+                # view v+n would even split its new view's CV sequence);
+                # the buffered requests stay in the pending list, which
+                # the view-change applier re-applies in the new view.
+                del self._buffers[view]
+            else:
+                self._flush(view)
+
     def _flush(self, view: int) -> None:
+        if self._suspended:
+            return
         buf = self._buffers.get(view)
         if not buf:
             return
@@ -194,6 +223,13 @@ class Handlers:
         self.handle_request_timeout = timeout_mod.make_request_timeout_handler(
             self.request_view_change
         )
+
+        # --- view-change protocol (beyond reference; core/viewchange.py)
+        self.view_change_state = viewchange_mod.ViewChangeState(n, f, replica_id)
+        self._viewchange_timeout = getattr(configer, "timeout_viewchange", 8.0)
+        self._viewchange_timer = None
+        self._viewchange_timer_view = 0  # the view the armed timer escalates
+        self._timer_provider = client_states.timers
 
         def start_request_timer(req: Request, view: int) -> None:
             timeout = configer.timeout_request
@@ -369,6 +405,14 @@ class Handlers:
         self.validate_commit = commit_mod.make_commit_validator(
             n, self.validate_prepare, self.verify_ui
         )
+        self.validate_view_change = _cached_validator(
+            viewchange_mod.make_view_change_validator(verify_ui)
+        )
+        self.validate_new_view = _cached_validator(
+            viewchange_mod.make_new_view_validator(
+                n, f, verify_ui, self.validate_view_change
+            )
+        )
 
         self.reply_request = request_mod.make_request_replier(self.client_states)
 
@@ -380,11 +424,15 @@ class Handlers:
         """Assign a UI under the global UI lock (serialized — USIG counters
         must match log order) and append to the broadcast log."""
         async with self._ui_lock:
-            if isinstance(msg, (Prepare, Commit)):
-                self.assign_ui(msg)
-                self.metrics.inc(
-                    "prepares_sent" if isinstance(msg, Prepare) else "commits_sent"
-                )
+            if isinstance(msg, (Prepare, Commit, ViewChange, NewView)):
+                if msg.ui is None:  # emit_view_change pre-assigns under
+                    self.assign_ui(msg)  # this same lock
+                if isinstance(msg, (Prepare, Commit)):
+                    self.metrics.inc(
+                        "prepares_sent"
+                        if isinstance(msg, Prepare)
+                        else "commits_sent"
+                    )
             self.message_log.append(msg)
 
     def _broadcast_signed(self, msg: Message) -> None:
@@ -404,6 +452,10 @@ class Handlers:
             await self.validate_commit(msg)
         elif isinstance(msg, ReqViewChange):
             await self.verify_signature(msg)
+        elif isinstance(msg, ViewChange):
+            await self.validate_view_change(msg)
+        elif isinstance(msg, NewView):
+            await self.validate_new_view(msg)
         else:
             raise api.AuthenticationError(f"unexpected message {stringify(msg)}")
 
@@ -414,18 +466,47 @@ class Handlers:
     async def process_message(self, msg: Message) -> bool:
         if isinstance(msg, Request):
             return await self.process_request(msg)
-        if isinstance(msg, (Prepare, Commit)):
+        if isinstance(msg, (Prepare, Commit, ViewChange, NewView)):
             return await self._process_peer_message(msg)
         if isinstance(msg, ReqViewChange):
-            # Reference refuses: "Not implemented"
-            # (core/message-handling.go:419).
-            self.log.warning(
-                "view change processing not implemented: %s", stringify(msg)
-            )
-            return False
+            # Beyond the reference (which refuses here, "Not implemented",
+            # core/message-handling.go:419): demands are tallied and f+1
+            # of them start the view-change transition.
+            return await self._process_req_view_change(msg)
         raise ValueError(f"unexpected message {stringify(msg)}")
 
     async def _process_peer_message(self, msg) -> bool:
+        if isinstance(msg, (ViewChange, NewView)):
+            # Certified view-change messages ride the same per-peer
+            # counter-ordered capture, but apply outside the view lease:
+            # NEW-VIEW application *advances* the view, which drains the
+            # lease it would otherwise hold.
+            if not await self.capture_ui(msg):
+                return False
+            if isinstance(msg, ViewChange):
+                return await self._apply_view_change(msg)
+            return await self._apply_new_view(msg)
+
+        msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
+        cur, _ = await self.view_state.hold_view()
+        if msg_view > cur:
+            # A message from a view this replica hasn't entered yet (its
+            # NEW-VIEW is still in flight): park until the transition
+            # catches up instead of consuming the peer's counter and
+            # losing the message.  Bounded: a claimed view that never
+            # materializes drops out after the view-change timeout.
+            try:
+                await asyncio.wait_for(
+                    self.view_state.wait_current_at_least(msg_view),
+                    max(self._viewchange_timeout, 1.0) * 2,
+                )
+            except asyncio.TimeoutError:
+                # The claimed view never materialized: fall through to the
+                # normal capture-then-refuse path rather than returning
+                # here — dropping WITHOUT capturing would leave a counter
+                # gap that wedges every later message from this peer.
+                self.metrics.inc("messages_dropped_future_view")
+
         # Process embedded messages first (reference processEmbedded,
         # core/message-handling.go:454-473).  A batched PREPARE embeds up
         # to batchsize requests and is itself embedded in every COMMIT —
@@ -452,15 +533,178 @@ class Handlers:
         # advancement could interleave — a message checked in view v must
         # not apply in view v+1.
         async with self.view_state.hold_view_lease() as (view, _):
-            msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
-            if msg_view != view:
+            if msg_view != view or self.view_change_state.in_transition(view):
+                # stale view, or this replica voted for a view change (the
+                # reference's !active state): captured but not applied —
+                # the transition's VIEW-CHANGE logs carry the evidence.
                 return False
 
             if isinstance(msg, Prepare):
+                if not self.view_change_state.check_reproposal(msg):
+                    # The new primary deviated from the agreed re-proposal
+                    # set S — refuse and demand its removal.
+                    self.log.warning(
+                        "new-view primary deviated from S: %s", stringify(msg)
+                    )
+                    await self.request_view_change(view + 1)
+                    return False
                 await self.apply_prepare(msg)
             else:
                 await self.apply_commit(msg)
             return True
+
+    # ------------------------------------------------------------------
+    # View-change protocol steps (beyond reference — core/viewchange.py).
+
+    async def _process_req_view_change(self, msg: ReqViewChange) -> bool:
+        cur, _ = await self.view_state.hold_view()
+        if not self.view_change_state.in_window(msg.new_view, cur):
+            return False  # stale, or absurdly far ahead (memory bound)
+        if self.view_change_state.record_demand(msg.replica_id, msg.new_view):
+            await self._start_transition(msg.new_view)
+        return True
+
+    async def _start_transition(self, new_view: int) -> None:
+        """f+1 demands reached: stop applying current-view messages and
+        broadcast this replica's certified VIEW-CHANGE."""
+        vcs = self.view_change_state
+        if new_view in vcs.sent_view_change:
+            return
+        vcs.sent_view_change.add(new_view)
+        await self.view_state.advance_expected_view(new_view)
+        self.metrics.inc("view_changes_started")
+
+        # If the new primary is faulty too, its NEW-VIEW never arrives:
+        # demand the next view after the view-change timeout.
+        def on_expiry() -> None:
+            async def escalate() -> None:
+                cur, _ = await self.view_state.hold_view()
+                if cur < new_view:
+                    self.metrics.inc("timeouts_viewchange")
+                    await self.request_view_change(new_view + 1)
+
+            asyncio.get_running_loop().create_task(escalate())
+
+        # Re-arm only forward: demand quorums can complete out of order,
+        # and a late lower-view transition must not silence the timer
+        # guarding a higher pending one (mirrors the NEW-VIEW cancel
+        # guard in _apply_new_view).
+        if self._viewchange_timeout > 0 and new_view >= self._viewchange_timer_view:
+            if self._viewchange_timer is not None:
+                self._viewchange_timer.cancel()
+            self._viewchange_timer = self._timer_provider.after(
+                self._viewchange_timeout, on_expiry
+            )
+            self._viewchange_timer_view = new_view
+
+        await self.emit_view_change(new_view)
+
+    async def emit_view_change(self, new_view: int) -> None:
+        """Build and broadcast this replica's VIEW-CHANGE.  The log
+        snapshot and the UI assignment happen under one UI lock hold, so
+        the claimed log is exactly counters 1..k and the VIEW-CHANGE gets
+        k+1 — the contiguity every receiver checks."""
+        async with self._ui_lock:
+            log = tuple(
+                viewchange_mod.trim_log_entry(m)
+                for m in self.message_log.snapshot()
+                if isinstance(m, (Prepare, Commit, ViewChange, NewView))
+                and m.ui is not None
+            )
+            vc = ViewChange(
+                replica_id=self.replica_id, new_view=new_view, log=log
+            )
+            self.assign_ui(vc)
+            self.metrics.inc("view_changes_sent")
+            self.message_log.append(vc)
+
+    async def _apply_view_change(self, vc: ViewChange) -> bool:
+        cur, _ = await self.view_state.hold_view()
+        if not self.view_change_state.in_window(vc.new_view, cur):
+            return False  # concluded view, or beyond the demand window
+        vcs = self.view_change_state
+        quorum = vcs.record_view_change(vc)
+        # A VIEW-CHANGE is implicitly a demand: a replica that missed the
+        # REQ-VIEW-CHANGE quorum still joins the transition once enough
+        # peers have moved (prevents stragglers from stalling in the old
+        # view while the quorum awaits their VIEW-CHANGE).
+        if vcs.record_demand(vc.replica_id, vc.new_view):
+            await self._start_transition(vc.new_view)
+        if (
+            quorum
+            and utils.is_primary(vc.new_view, self.replica_id, self.n)
+            and vc.new_view not in vcs.sent_new_view
+        ):
+            vcs.sent_new_view.add(vc.new_view)
+            nv = NewView(
+                replica_id=self.replica_id,
+                new_view=vc.new_view,
+                view_changes=tuple(vcs.quorum_for(vc.new_view)),
+            )
+            await self.handle_generated(nv)
+        return True
+
+    async def _apply_new_view(self, nv: NewView) -> bool:
+        """Enter ``nv.new_view``: derive the re-proposal set S, arm its
+        enforcement, register the new primary's counter base, advance the
+        view, and (as the new primary) certify S before any fresh
+        proposal."""
+        cur, _ = await self.view_state.hold_view()
+        if nv.new_view <= cur:
+            return False
+        s_prepares = viewchange_mod.compute_new_view_set(
+            nv.view_changes, nv.new_view
+        )
+        batches = [viewchange_mod.batch_key(p) for p in s_prepares]
+        self.view_change_state.arm_reproposals(nv.new_view, list(batches))
+        self.commitment_collector.set_view_base(nv.new_view, nv.ui.counter)
+
+        self._prepare_batcher.suspend()
+        try:
+            await self.view_state.advance_expected_view(nv.new_view)
+            if not await self.view_state.advance_current_view(nv.new_view):
+                return False
+            if (
+                self._viewchange_timer is not None
+                and self._viewchange_timer_view <= nv.new_view
+            ):
+                # Only disarm an escalation this NEW-VIEW satisfies — a
+                # late NEW-VIEW for an older view must not silence the
+                # timer still guarding a higher pending transition.
+                self._viewchange_timer.cancel()
+                self._viewchange_timer = None
+            self.view_change_state.prune_through(nv.new_view)
+            self.commitment_collector.prune_view_bases(nv.new_view)
+            self.metrics.inc("view_changes_completed")
+            self.log.info(
+                "entered view %d (%d re-proposals)",
+                nv.new_view,
+                len(s_prepares),
+            )
+            if utils.is_primary(nv.new_view, self.replica_id, self.n):
+                for p in s_prepares:
+                    await self.handle_generated(
+                        Prepare(
+                            replica_id=self.replica_id,
+                            view=nv.new_view,
+                            requests=p.requests,
+                        )
+                    )
+        finally:
+            cur_after, _ = await self.view_state.hold_view()
+            self._prepare_batcher.resume(cur_after)
+
+        # Re-apply pending requests in the new view (the primary proposes
+        # them; backups restart prepare timers) — skipping those S already
+        # re-proposed.
+        reproposed = {key for b in batches for key in b}
+        for req in self.pending.all():
+            if (req.client_id, req.seq) in reproposed:
+                continue
+            async with self.view_state.hold_view_lease() as (view, _):
+                if view == nv.new_view:
+                    await self.apply_request(req, view)
+        return True
 
     # ------------------------------------------------------------------
     # Top-level handlers (reference handleClientMessage / handlePeerMessage /
@@ -482,7 +726,9 @@ class Handlers:
         return await self.reply_request(msg)
 
     async def handle_peer_message(self, msg: Message) -> None:
-        if isinstance(msg, (Prepare, Commit, ReqViewChange, Request)):
+        if isinstance(
+            msg, (Prepare, Commit, ReqViewChange, ViewChange, NewView, Request)
+        ):
             self.metrics.inc("messages_handled")
             try:
                 await self.validate_message(msg)
@@ -492,9 +738,9 @@ class Handlers:
                 # primary).  The primary's counter has moved past a
                 # message we will never accept, so every later message
                 # from it would park on the gap — demand a view change
-                # instead of wedging (view-change *processing* is still
-                # reference-parity unimplemented; the demand is the
-                # fault-evidence signal, like a request timeout).
+                # instead of wedging; with f+1 peers demanding, the full
+                # view-change protocol (core/viewchange.py) deposes the
+                # primary.
                 view = (
                     msg.view
                     if isinstance(msg, Prepare)
@@ -511,9 +757,13 @@ class Handlers:
 
     async def handle_own_message(self, msg: Message) -> None:
         """Own messages replayed from the log are trusted — no validation
-        (reference handleOwnMessage, core/message-handling.go:352-361)."""
-        if isinstance(msg, (Prepare, Commit)):
+        (reference handleOwnMessage, core/message-handling.go:352-361).
+        Own REQ-VIEW-CHANGE/VIEW-CHANGE/NEW-VIEW count toward our own
+        quorums the same way peers' do."""
+        if isinstance(msg, (Prepare, Commit, ViewChange, NewView)):
             await self._process_peer_message(msg)
+        elif isinstance(msg, ReqViewChange):
+            await self._process_req_view_change(msg)
 
 
 # ---------------------------------------------------------------------------

@@ -2,11 +2,11 @@
 
 Reference core/timeout.go:32-72 and core/request.go:280-340: when a pending
 request's timer expires, the replica demands view v+1 and broadcasts a
-signed REQ-VIEW-CHANGE; peers do not process it (view change recovery is
-"Not implemented" in the reference, core/message-handling.go:419 — the same
-boundary is kept here, see ``handle_req_view_change``).  The prepare-timer
-fallback forwards the starved REQUEST to the primary via its unicast log
-(reference core/request.go:315-324).
+signed REQ-VIEW-CHANGE.  The reference stops there (processing is "Not
+implemented", core/message-handling.go:419); this build goes beyond it —
+f+1 demands start the full view-change protocol (core/viewchange.py).  The
+prepare-timer fallback forwards the starved REQUEST to the primary via its
+unicast log (reference core/request.go:315-324).
 """
 
 from __future__ import annotations

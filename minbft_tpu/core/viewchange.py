@@ -1,0 +1,275 @@
+"""View-change protocol — **beyond the reference**, which stops at emitting
+REQ-VIEW-CHANGE and refuses to process it ("Not implemented",
+reference core/message-handling.go:419; roadmap README.md:490-497).
+
+The protocol follows the MinBFT paper (§IV-B of "Efficient Byzantine
+Fault-Tolerance", Veronese et al. 2013), adapted to this build's asyncio
+closure graph and USIG machinery:
+
+1. A replica suspecting the primary broadcasts a *signed*
+   REQ-VIEW-CHANGE(v+1) (reference-parity part, core/timeout.py).
+2. On f+1 distinct demands for view v' > current, a replica enters the
+   transition: it stops applying view-v messages (the read-lease check in
+   ``message_handling``) and broadcasts a *certified* VIEW-CHANGE carrying
+   its complete certified-message log.  Log completeness is enforced by
+   USIG itself: the entries' counters must be exactly 1..k with the
+   VIEW-CHANGE at k+1 — omitting a sent message leaves a visible gap, so
+   even a faulty quorum member exposes the commit evidence it holds
+   (this is what makes the f+1 quorum of an n = 2f+1 system sufficient).
+3. The new primary (v' mod n) collects f+1 VIEW-CHANGEs and broadcasts a
+   certified NEW-VIEW embedding them.  Every replica derives the same
+   re-proposal set S from those f+1 logs (:func:`compute_new_view_set`),
+   enters v', and expects the new primary's first PREPAREs to re-propose
+   exactly S in order — a deviation is refused and answered with a demand
+   for v'+1.  The NEW-VIEW's own UI counter is the base from which the
+   new primary's PREPARE counters continue
+   (:meth:`minbft_tpu.core.commit.CommitmentCollector.set_view_base`).
+4. Re-proposed requests that were already executed are absorbed by the
+   per-client retire watermark (execute-once), so state machines converge
+   without double execution.
+
+Safety sketch: a request executed anywhere needed f+1 commitments; any
+f+1 VIEW-CHANGE quorum intersects that commitment quorum in at least one
+replica, whose log — complete by the counter-gap argument — contains its
+PREPARE/COMMIT for the request, so S re-proposes it before any new
+request, in the original (view, counter) order.
+
+Without checkpoints the VIEW-CHANGE log grows from genesis — the same
+unboundedness as the reference's in-memory message log; checkpointing/GC
+remains the shared roadmap item.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import api
+from ..messages import Commit, NewView, Prepare, ViewChange
+from . import utils
+
+# A batch key: the (client, seq) identity of each request a PREPARE orders.
+BatchKey = Tuple[Tuple[int, int], ...]
+
+
+def batch_key(prepare: Prepare) -> BatchKey:
+    return tuple((r.client_id, r.seq) for r in prepare.requests)
+
+
+def compute_new_view_set(
+    view_changes, new_view: int
+) -> List[Prepare]:
+    """Derive the deterministic re-proposal set S from a NEW-VIEW's f+1
+    VIEW-CHANGEs: every PREPARE of a view < new_view appearing in any log
+    (directly, or embedded in a COMMIT), ordered by (view, primary CV) and
+    deduplicated — USIG uniqueness guarantees one PREPARE per (primary,
+    counter), so the map cannot collide on conflicting proposals."""
+    prepares: Dict[Tuple[int, int], Prepare] = {}
+    for vc in view_changes:
+        for entry in vc.log:
+            cand: Optional[Prepare] = None
+            if isinstance(entry, Prepare):
+                cand = entry
+            elif isinstance(entry, Commit):
+                cand = entry.prepare
+            if cand is None or cand.ui is None or cand.view >= new_view:
+                continue
+            prepares[(cand.view, cand.ui.counter)] = cand
+    return [prepares[k] for k in sorted(prepares)]
+
+
+class ViewChangeState:
+    """Per-replica bookkeeping for the view-change rounds.
+
+    Memory is bounded: demands/collections are only accepted within
+    ``MAX_VIEWS_AHEAD`` of the current view (honest escalation advances
+    one view per timeout, so the window is generous), and concluded
+    views' bookkeeping is pruned on view entry — a faulty replica cannot
+    grow state by demanding views 10^9 apart."""
+
+    MAX_VIEWS_AHEAD = 64
+
+    def __init__(self, n: int, f: int, replica_id: int):
+        self.n = n
+        self.f = f
+        self.replica_id = replica_id
+        # REQ-VIEW-CHANGE demand votes: new_view -> demanding replica ids
+        self.req_votes: Dict[int, Set[int]] = {}
+        # collected VIEW-CHANGEs: new_view -> replica -> message
+        self.view_changes: Dict[int, Dict[int, ViewChange]] = {}
+        self.sent_view_change: Set[int] = set()  # new_views we voted for
+        self.sent_new_view: Set[int] = set()
+        # re-proposal enforcement, keyed per view: entering a view, the
+        # new primary's first PREPAREs must match these batches in order.
+        # Per-view (not a single slot): concurrent NEW-VIEW applications
+        # during escalation must not overwrite the winning view's regime.
+        self.reproposals: Dict[int, deque] = {}
+
+    def in_window(self, new_view: int, current: int) -> bool:
+        return current < new_view <= current + self.MAX_VIEWS_AHEAD
+
+    def in_transition(self, current: int) -> bool:
+        """True while this replica has VOTED (sent a VIEW-CHANGE) for a
+        view beyond ``current`` — the window during which current-view
+        messages are not applied.  Keyed on the actual vote, not on the
+        expected-view watermark: a solo spurious demand advances the
+        watermark without a quorum, and gating on it would wedge the
+        replica until f+1 peers happened to demand too."""
+        return any(v > current for v in self.sent_view_change)
+
+    # -- demand votes -------------------------------------------------------
+
+    def record_demand(self, replica_id: int, new_view: int) -> bool:
+        """Record one REQ-VIEW-CHANGE; True when the f+1 quorum for
+        ``new_view`` is (now) complete."""
+        votes = self.req_votes.setdefault(new_view, set())
+        votes.add(replica_id)
+        return len(votes) >= self.f + 1
+
+    # -- view-change collection --------------------------------------------
+
+    def record_view_change(self, vc: ViewChange) -> bool:
+        """Record one validated VIEW-CHANGE; True when f+1 distinct
+        replicas' messages for ``vc.new_view`` are available.  Only the
+        first VIEW-CHANGE per (replica, view) counts — USIG counter order
+        means every correct replica sees the same first one."""
+        per_view = self.view_changes.setdefault(vc.new_view, {})
+        per_view.setdefault(vc.replica_id, vc)
+        return len(per_view) >= self.f + 1
+
+    def quorum_for(self, new_view: int) -> List[ViewChange]:
+        """The deterministic f+1-subset used to build NEW-VIEW: lowest
+        replica ids first."""
+        per_view = self.view_changes.get(new_view, {})
+        picked = sorted(per_view)[: self.f + 1]
+        return [per_view[r] for r in picked]
+
+    def prune_through(self, view: int) -> None:
+        """Drop bookkeeping for concluded views (memory stays O(pending
+        transitions), not O(views ever demanded))."""
+        for d in (self.req_votes, self.view_changes):
+            for v in [v for v in d if v <= view]:
+                del d[v]
+        self.sent_view_change = {v for v in self.sent_view_change if v > view}
+        self.sent_new_view = {v for v in self.sent_new_view if v > view}
+        for v in [v for v in self.reproposals if v < view]:
+            del self.reproposals[v]
+
+    # -- re-proposal enforcement -------------------------------------------
+
+    def arm_reproposals(self, new_view: int, batches: List[BatchKey]) -> None:
+        self.reproposals.setdefault(new_view, deque(batches))
+
+    def check_reproposal(self, prepare: Prepare) -> bool:
+        """True if ``prepare`` is acceptable under the re-proposal regime:
+        either no regime is active for its view, or it matches the next
+        expected batch (which it consumes)."""
+        expected = self.reproposals.get(prepare.view)
+        if not expected:
+            return True  # no active regime for this prepare's view
+        if batch_key(prepare) != expected[0]:
+            return False
+        expected.popleft()
+        if not expected:
+            del self.reproposals[prepare.view]
+        return True
+
+
+def trim_log_entry(entry):
+    """The wire form of a prior VIEW-CHANGE/NEW-VIEW inside a log: payload
+    emptied, its canonical digest carried instead — same authen bytes, so
+    the original UI certificate verifies on the trimmed copy, and logs stay
+    linear instead of nesting every earlier log (exponential growth)."""
+    from ..messages.authen import collection_digest
+
+    if isinstance(entry, ViewChange) and entry.log:
+        return ViewChange(
+            replica_id=entry.replica_id,
+            new_view=entry.new_view,
+            log=(),
+            ui=entry.ui,
+            log_digest=collection_digest(entry.log, entry.log_digest),
+        )
+    if isinstance(entry, NewView) and entry.view_changes:
+        return NewView(
+            replica_id=entry.replica_id,
+            new_view=entry.new_view,
+            view_changes=(),
+            ui=entry.ui,
+            vcs_digest=collection_digest(entry.view_changes, entry.vcs_digest),
+        )
+    return entry
+
+
+def make_view_change_validator(verify_ui):
+    """Validate a VIEW-CHANGE: its own UI plus the USIG log-completeness
+    invariant — entries are the sender's certified messages with counters
+    exactly 1..k and the VIEW-CHANGE itself at k+1.  Embedded foreign
+    PREPAREs (inside the sender's COMMITs) are verified too, since the
+    re-proposal set derives (view, counter) slots from them."""
+
+    async def validate_view_change(vc: ViewChange) -> None:
+        checks = []
+        for i, entry in enumerate(vc.log):
+            if entry.replica_id != vc.replica_id:
+                raise api.AuthenticationError(
+                    "VIEW-CHANGE log entry from another replica"
+                )
+            if entry.ui is None or entry.ui.counter != i + 1:
+                raise api.AuthenticationError(
+                    "VIEW-CHANGE log has a counter gap: omitted messages"
+                )
+            if isinstance(entry, ViewChange) and entry.log:
+                # nested logs must arrive trimmed (see trim_log_entry) —
+                # otherwise one message re-nests the whole history
+                raise api.AuthenticationError(
+                    "VIEW-CHANGE log entry must be trimmed"
+                )
+            if isinstance(entry, NewView) and entry.view_changes:
+                raise api.AuthenticationError(
+                    "NEW-VIEW log entry must be trimmed"
+                )
+            checks.append(verify_ui(entry))
+            if isinstance(entry, Commit):
+                checks.append(verify_ui(entry.prepare))
+        # Entry checks are stateless: gather them so they co-batch on the
+        # verification engine (the log grows with history — one serial
+        # engine round-trip per entry would stall recovery; the gather
+        # collapses them to ~one batch, prepare.py's house pattern).
+        results = await asyncio.gather(*checks, return_exceptions=True)
+        for res in results:
+            if isinstance(res, BaseException):
+                raise res
+        ui = await verify_ui(vc)
+        if ui.counter != len(vc.log) + 1:
+            raise api.AuthenticationError(
+                "VIEW-CHANGE counter does not extend its log"
+            )
+
+    return validate_view_change
+
+
+def make_new_view_validator(n: int, f: int, verify_ui, validate_view_change):
+    """Validate a NEW-VIEW: sent by the view's primary, carrying f+1
+    distinct valid VIEW-CHANGEs for the same view."""
+
+    async def validate_new_view(nv: NewView) -> None:
+        if not utils.is_primary(nv.new_view, nv.replica_id, n):
+            raise api.AuthenticationError(
+                "NEW-VIEW from a replica that is not the view's primary"
+            )
+        senders = {vc.replica_id for vc in nv.view_changes}
+        if len(nv.view_changes) != f + 1 or len(senders) != f + 1:
+            raise api.AuthenticationError(
+                "NEW-VIEW must carry f+1 distinct VIEW-CHANGEs"
+            )
+        for vc in nv.view_changes:
+            if vc.new_view != nv.new_view:
+                raise api.AuthenticationError(
+                    "NEW-VIEW embeds a VIEW-CHANGE for another view"
+                )
+            await validate_view_change(vc)
+        await verify_ui(nv)
+
+    return validate_new_view

@@ -16,10 +16,12 @@ from .message import (
     Commit,
     Hello,
     Message,
+    NewView,
     Prepare,
     ReqViewChange,
     Reply,
     Request,
+    ViewChange,
     is_client_message,
     is_peer_message,
 )
@@ -34,6 +36,8 @@ __all__ = [
     "Prepare",
     "Commit",
     "ReqViewChange",
+    "ViewChange",
+    "NewView",
     "CLIENT_MESSAGES",
     "REPLICA_MESSAGES",
     "PEER_MESSAGES",

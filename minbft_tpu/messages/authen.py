@@ -26,7 +26,16 @@ import hashlib
 import struct
 
 from . import codec
-from .message import Commit, Message, Prepare, ReqViewChange, Reply, Request
+from .message import (
+    Commit,
+    Message,
+    NewView,
+    Prepare,
+    ReqViewChange,
+    Reply,
+    Request,
+    ViewChange,
+)
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
@@ -95,7 +104,40 @@ def _authen_bytes(m: Message) -> bytes:
         )
     if isinstance(m, ReqViewChange):
         return b"REQ-VIEW-CHANGE" + _U32.pack(m.replica_id) + _U64.pack(m.new_view)
+    if isinstance(m, ViewChange):
+        # Covers every log entry *with* its UI (in counter order): the
+        # sender's USIG certifies exactly this claimed history.  A trimmed
+        # copy (empty log, digest carried) authenticates identically, so
+        # the original certificate verifies on it (see ViewChange doc).
+        return (
+            b"VIEW-CHANGE"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.new_view)
+            + collection_digest(m.log, m.log_digest)
+        )
+    if isinstance(m, NewView):
+        # Covers the f+1 embedded VIEW-CHANGEs with their UIs — the quorum
+        # that deterministically defines the re-proposal set.
+        return (
+            b"NEW-VIEW"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.new_view)
+            + collection_digest(m.view_changes, m.vcs_digest)
+        )
     raise TypeError(f"{type(m).__name__} has no authen bytes")
+
+
+def collection_digest(entries, carried: bytes) -> bytes:
+    """Digest of a message collection, or the carried digest for a trimmed
+    copy.  Non-empty collections are always recomputed — a mismatched
+    carried digest on a full message simply fails certificate verification
+    (both sides apply the same rule)."""
+    if not entries:
+        return carried if carried else _sha256(b"")
+    h = hashlib.sha256()
+    for entry in entries:
+        h.update(codec.marshal(entry))
+    return h.digest()
 
 
 def authen_digest(m: Message) -> bytes:

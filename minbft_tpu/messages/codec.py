@@ -27,10 +27,12 @@ from .message import (
     Commit,
     Hello,
     Message,
+    NewView,
     Prepare,
     ReqViewChange,
     Reply,
     Request,
+    ViewChange,
 )
 
 # Kind tags (wire stable).
@@ -40,6 +42,8 @@ _TAG_REPLY = 0x03
 _TAG_PREPARE = 0x04
 _TAG_COMMIT = 0x05
 _TAG_REQ_VIEW_CHANGE = 0x06
+_TAG_VIEW_CHANGE = 0x07
+_TAG_NEW_VIEW = 0x08
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
@@ -150,6 +154,26 @@ def marshal(m: Message) -> bytes:
             + _pack_u64(m.new_view)
             + _pack_bytes(m.signature)
         )
+    if isinstance(m, ViewChange):
+        return (
+            bytes([_TAG_VIEW_CHANGE])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.new_view)
+            + _pack_u32(len(m.log))
+            + b"".join(_pack_bytes(marshal(e)) for e in m.log)
+            + _pack_bytes(m.log_digest)
+            + _pack_ui(m.ui)
+        )
+    if isinstance(m, NewView):
+        return (
+            bytes([_TAG_NEW_VIEW])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.new_view)
+            + _pack_u32(len(m.view_changes))
+            + b"".join(_pack_bytes(marshal(vc)) for vc in m.view_changes)
+            + _pack_bytes(m.vcs_digest)
+            + _pack_ui(m.ui)
+        )
     raise CodecError(f"unknown message type {type(m)!r}")
 
 
@@ -258,4 +282,44 @@ def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
         nv, off = _read_u64(data, off)
         sig, off = _read_bytes(data, off)
         return ReqViewChange(replica_id=rid, new_view=nv, signature=sig), off
+    if tag == _TAG_VIEW_CHANGE:
+        rid, off = _read_u32(data, off)
+        nv, off = _read_u64(data, off)
+        count, off = _read_u32(data, off)
+        entries = []
+        for _ in range(count):
+            eb, off = _read_bytes(data, off)
+            entry = unmarshal(eb)
+            if not isinstance(entry, (Prepare, Commit, ViewChange, NewView)):
+                raise CodecError("VIEW-CHANGE log entries must be certified")
+            entries.append(entry)
+        digest, off = _read_bytes(data, off)
+        uib, off = _read_bytes(data, off)
+        return (
+            ViewChange(
+                replica_id=rid, new_view=nv, log=tuple(entries),
+                ui=_parse_ui(uib), log_digest=digest,
+            ),
+            off,
+        )
+    if tag == _TAG_NEW_VIEW:
+        rid, off = _read_u32(data, off)
+        nv, off = _read_u64(data, off)
+        count, off = _read_u32(data, off)
+        vcs = []
+        for _ in range(count):
+            vcb, off = _read_bytes(data, off)
+            vc = unmarshal(vcb)
+            if not isinstance(vc, ViewChange):
+                raise CodecError("NEW-VIEW must embed VIEW-CHANGEs")
+            vcs.append(vc)
+        digest, off = _read_bytes(data, off)
+        uib, off = _read_bytes(data, off)
+        return (
+            NewView(
+                replica_id=rid, new_view=nv, view_changes=tuple(vcs),
+                ui=_parse_ui(uib), vcs_digest=digest,
+            ),
+            off,
+        )
     raise CodecError(f"unknown message tag {tag:#x}")

@@ -157,13 +157,68 @@ class ReqViewChange(Message):
     signature: bytes = b""
 
 
+@dataclasses.dataclass
+class ViewChange(Message):
+    """A replica's vote to enter ``new_view``, certified by its USIG and
+    carrying its complete certified-message log since the genesis
+    checkpoint (**beyond the reference**, whose view change stops at the
+    REQ-VIEW-CHANGE demand — reference core/message-handling.go:419 "Not
+    implemented"; protocol per the MinBFT paper §IV-B).
+
+    The log is what makes n = 2f+1 view changes safe: a quorum member
+    cannot *omit* a message it sent — every certified message consumes one
+    USIG counter value, so receivers check the log's counters are exactly
+    1..k with the VIEW-CHANGE itself at k+1, and any omission is a visible
+    gap.  Whoever of the commit quorum lands in the view-change quorum
+    therefore exposes the commitment evidence, faulty or not.
+
+    Prior VIEW-CHANGE/NEW-VIEW messages appear in the log **trimmed**:
+    their own payload emptied and ``log_digest`` carrying the canonical
+    digest of what they covered.  A trimmed copy has the *same* authen
+    bytes as the original (the digest substitutes for the recomputation),
+    so the original UI certificate still verifies — the counter slot stays
+    provably occupied without nesting the prior log, which would otherwise
+    double the message per view change (exponential growth).  Log size is
+    thus linear in certified PREPAREs/COMMITs — the same unboundedness as
+    the reference's in-memory message log; checkpointing/GC is a roadmap
+    item in both builds.
+    """
+
+    KIND = "VIEW-CHANGE"
+    replica_id: int
+    new_view: int
+    log: Tuple[Message, ...]
+    ui: Optional[UI] = None
+    # Canonical digest of the (possibly trimmed-away) log contents; filled
+    # on the wire so trimmed copies keep the original's authen bytes.
+    log_digest: bytes = b""
+
+
+@dataclasses.dataclass
+class NewView(Message):
+    """The new primary's certified announcement of ``new_view``: carries
+    f+1 VIEW-CHANGEs (its quorum, own included) from which every replica
+    deterministically derives the re-proposal set (see
+    :func:`minbft_tpu.core.viewchange.compute_new_view_set`).  The
+    NEW-VIEW's own UI counter is the base the new primary's PREPARE
+    counters continue from."""
+
+    KIND = "NEW-VIEW"
+    replica_id: int
+    new_view: int
+    view_changes: Tuple["ViewChange", ...]
+    ui: Optional[UI] = None
+    # Same trimming mechanism as ViewChange.log_digest.
+    vcs_digest: bytes = b""
+
+
 # ---------------------------------------------------------------------------
 # Classification helpers (reference messages/api.go interface hierarchy).
 
 CLIENT_MESSAGES = (Request,)
-REPLICA_MESSAGES = (Reply, Prepare, Commit, ReqViewChange)
-PEER_MESSAGES = (Prepare, Commit, ReqViewChange)
-CERTIFIED_MESSAGES = (Prepare, Commit)  # carry a USIG UI
+REPLICA_MESSAGES = (Reply, Prepare, Commit, ReqViewChange, ViewChange, NewView)
+PEER_MESSAGES = (Prepare, Commit, ReqViewChange, ViewChange, NewView)
+CERTIFIED_MESSAGES = (Prepare, Commit, ViewChange, NewView)  # carry a USIG UI
 SIGNED_MESSAGES = (Request, Reply, ReqViewChange)  # carry a plain signature
 
 

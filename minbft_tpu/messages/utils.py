@@ -2,7 +2,17 @@
 
 from __future__ import annotations
 
-from .message import Commit, Hello, Message, Prepare, ReqViewChange, Reply, Request
+from .message import (
+    Commit,
+    Hello,
+    Message,
+    NewView,
+    Prepare,
+    ReqViewChange,
+    Reply,
+    Request,
+    ViewChange,
+)
 
 
 def stringify(m: Message) -> str:
@@ -30,4 +40,16 @@ def stringify(m: Message) -> str:
         )
     if isinstance(m, ReqViewChange):
         return f"<REQ-VIEW-CHANGE replica={m.replica_id} new_view={m.new_view}>"
+    if isinstance(m, ViewChange):
+        cv = m.ui.counter if m.ui else None
+        return (
+            f"<VIEW-CHANGE cv={cv} replica={m.replica_id} "
+            f"new_view={m.new_view} log={len(m.log)}>"
+        )
+    if isinstance(m, NewView):
+        cv = m.ui.counter if m.ui else None
+        return (
+            f"<NEW-VIEW cv={cv} replica={m.replica_id} "
+            f"new_view={m.new_view} vcs={len(m.view_changes)}>"
+        )
     return f"<{type(m).__name__}>"

@@ -125,6 +125,7 @@ class SampleAuthenticator(api.Authenticator):
         usig_ids: Optional[Dict[int, bytes]] = None,
         engine: Optional[BatchVerifier] = None,
         batch_signatures: bool = True,
+        own_replica_id: Optional[int] = None,
     ):
         self._scheme = SCHEMES[scheme]
         self._client_priv = client_priv
@@ -139,6 +140,19 @@ class SampleAuthenticator(api.Authenticator):
         # higher-counter UIs wait instead of spuriously failing.
         self._usig_epochs: Dict[int, bytes] = {}
         self._usig_epoch_pending: Dict[int, "asyncio.Future"] = {}
+        # Self-anchor: our own epoch needs no first-contact capture — we
+        # ARE the trusted source.  Without this, a replica that becomes
+        # primary after a view change cannot verify its own UIs embedded
+        # in peers' COMMITs: its own counter-1 message never passes
+        # through its validation path (own messages are trusted), so TOFU
+        # would wait for a first contact that cannot happen.  Keyed by the
+        # explicit own id — anchors alone cannot identify "self" (the
+        # HMAC scheme's key fingerprint is shared by every replica).
+        if usig is not None and own_replica_id is not None:
+            anchor = self._usig_ids.get(own_replica_id)
+            own_id = usig.id()
+            if anchor is not None and own_id[_EPOCH_LEN:] == anchor:
+                self._usig_epochs[own_replica_id] = own_id[:_EPOCH_LEN]
         # How long a non-counter-1 UI waits for a first-contact capture
         # before rejecting (only relevant before a peer's epoch is known).
         self.tofu_capture_timeout = 10.0
@@ -380,10 +394,15 @@ def new_test_authenticators(
     engines: Optional[list] = None,
     batch_signatures: bool = True,
     client_engine: Optional[BatchVerifier] = None,
+    tofu_anchors: bool = False,
 ):
     """Generate a coherent set of authenticators for an in-process testnet
     (the reference's GenerateTestnetKeys equivalent,
     sample/authentication/keymanager.go:404-450).
+
+    ``tofu_anchors=True`` hands out key-material anchors instead of full
+    pinned IDs, so the epoch trust-on-first-use machinery (incl. the
+    constructor self-anchor) is exercised like a deployed keystore.
 
     Returns (replica_auths, client_auths)."""
     if scheme == "ecdsa-p256":
@@ -400,6 +419,8 @@ def new_test_authenticators(
         raise ValueError(scheme)
 
     usigs, usig_ids = make_testnet_usigs(n, usig_kind)
+    if tofu_anchors:
+        usig_ids = {i: uid[_EPOCH_LEN:] for i, uid in usig_ids.items()}
 
     replica_auths = [
         SampleAuthenticator(
@@ -411,6 +432,7 @@ def new_test_authenticators(
             usig_ids=usig_ids,
             engine=(engines[i] if engines else engine),
             batch_signatures=batch_signatures,
+            own_replica_id=i,
         )
         for i in range(n)
     ]

@@ -383,6 +383,7 @@ class KeyStore:
             usig_ids=self.usig_anchors(),
             engine=engine,
             batch_signatures=batch_signatures,
+            own_replica_id=replica_id,
         )
 
     def mac_replica_authenticator(
@@ -400,6 +401,7 @@ class KeyStore:
             usig_ids=self.usig_anchors(),
             engine=engine,
             batch_signatures=False,
+            own_replica_id=replica_id,
         )
         # The principal's view only — handing out the full matrix would let
         # one compromised replica forge other principals' MAC slots.

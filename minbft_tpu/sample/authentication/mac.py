@@ -252,6 +252,7 @@ def new_test_mac_authenticators(
             usig_ids=usig_ids,
             engine=(engines[i] if engines else engine),
             batch_signatures=False,
+            own_replica_id=i,
         )
         for i in range(n)
     ]

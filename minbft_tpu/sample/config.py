@@ -36,6 +36,7 @@ class SimpleConfiger(api.Configer):
         logsize: int = 0,
         timeout_request: float = 2.0,
         timeout_prepare: float = 1.0,
+        timeout_viewchange: float = 8.0,
         peers: Optional[List[PeerAddr]] = None,
         batchsize_prepare: int = 64,
     ):
@@ -45,6 +46,7 @@ class SimpleConfiger(api.Configer):
         self._logsize = logsize
         self._timeout_request = timeout_request
         self._timeout_prepare = timeout_prepare
+        self._timeout_viewchange = timeout_viewchange
         self.peers = peers or []
         # Max requests coalesced into one PREPARE (this build's request
         # batching; the reference has none — roadmap README.md:505).
@@ -73,6 +75,10 @@ class SimpleConfiger(api.Configer):
     @property
     def timeout_prepare(self) -> float:
         return self._timeout_prepare
+
+    @property
+    def timeout_viewchange(self) -> float:
+        return self._timeout_viewchange
 
 
 def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfiger:
@@ -106,6 +112,9 @@ def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfig
         ),
         timeout_prepare=layered(
             "TIMEOUT_PREPARE", timeout.get("prepare", "1s"), _seconds
+        ),
+        timeout_viewchange=layered(
+            "TIMEOUT_VIEWCHANGE", timeout.get("viewchange", "8s"), _seconds
         ),
         peers=peers,
         batchsize_prepare=layered(

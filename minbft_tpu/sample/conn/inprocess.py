@@ -21,10 +21,18 @@ class ReplicaStub(api.ConnectionHandler):
     def __init__(self):
         self._replica: Optional[api.Replica] = None
         self._ready = asyncio.Event()
+        self._crashed = asyncio.Event()
 
     def assign_replica(self, replica: api.Replica) -> None:
         self._replica = replica
         self._ready.set()
+
+    def crash(self) -> None:
+        """Simulate a process crash: every live stream through this stub
+        ends and new ones never start (the in-process analogue of killing
+        a replica process, reference README.md:411-458) — used by the
+        view-change tests to take the primary down for real."""
+        self._crashed.set()
 
     def peer_message_stream_handler(self) -> api.MessageStreamHandler:
         return _DeferredHandler(self, "peer")
@@ -42,14 +50,47 @@ class _DeferredHandler(api.MessageStreamHandler):
         self, in_stream: AsyncIterator[bytes]
     ) -> AsyncIterator[bytes]:
         await self._stub._ready.wait()
+        if self._stub._crashed.is_set():
+            return
         replica = self._stub._replica
         handler = (
             replica.peer_message_stream_handler()
             if self._kind == "peer"
             else replica.client_message_stream_handler()
         )
-        async for out in handler.handle_message_stream(in_stream):
-            yield out
+        agen = handler.handle_message_stream(in_stream)
+        crashed = asyncio.ensure_future(self._stub._crashed.wait())
+        nxt = None
+        try:
+            while True:
+                nxt = asyncio.ensure_future(agen.__anext__())
+                done, _ = await asyncio.wait(
+                    {nxt, crashed}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if crashed in done:
+                    break
+                try:
+                    out = nxt.result()
+                except StopAsyncIteration:
+                    break
+                nxt = None
+                yield out
+        finally:
+            # May run under GeneratorExit (caller closed us), where
+            # awaiting is not allowed: cancel the in-flight step (which
+            # unwinds the inner generator at its suspend point) and
+            # schedule the close instead of awaiting it.
+            crashed.cancel()
+            if nxt is not None and not nxt.done():
+                nxt.cancel()
+
+            async def _close() -> None:
+                try:
+                    await agen.aclose()
+                except BaseException:
+                    pass
+
+            asyncio.get_running_loop().create_task(_close())
 
 
 class InProcessPeerConnector(api.ReplicaConnector):

@@ -24,10 +24,14 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-async def make_cluster(n=4, f=1, n_clients=1, usig_kind="hmac", **auth_kw):
+async def make_cluster(
+    n=4, f=1, n_clients=1, usig_kind="hmac", cfg=None, **auth_kw
+):
     """Start an in-process cluster (the reference integration-test layout,
     core/integration_test.go:212-226).  Returns (replicas, client_auths,
-    stubs, ledgers); caller stops the replicas."""
+    stubs, ledgers); caller stops the replicas.  Pass ``cfg`` to override
+    the default long-timeout SimpleConfiger (e.g. short timeouts for
+    view-change tests)."""
     from minbft_tpu.core import new_replica
     from minbft_tpu.sample.authentication import new_test_authenticators
     from minbft_tpu.sample.config import SimpleConfiger
@@ -37,7 +41,8 @@ async def make_cluster(n=4, f=1, n_clients=1, usig_kind="hmac", **auth_kw):
     )
     from minbft_tpu.sample.requestconsumer import SimpleLedger
 
-    cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+    if cfg is None:
+        cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
     r_auths, c_auths = new_test_authenticators(
         n, n_clients=n_clients, usig_kind=usig_kind, **auth_kw
     )

@@ -120,10 +120,13 @@ def test_validate_dispatch_rejects_unexpected_kind():
             await h.validate_message(Hello(replica_id=1))
         with pytest.raises(ValueError):
             await h.process_message(Hello(replica_id=1))
-        # ReqViewChange processing is reference-parity unimplemented
-        # (core/message-handling.go:419): refused, not crashed.
+        # ReqViewChange processing (beyond the reference's refusal):
+        # fresh demands are tallied, stale ones dropped.
         rvc = ReqViewChange(replica_id=1, new_view=1)
-        assert await h.process_message(rvc) is False
+        assert await h.process_message(rvc) is True
+        assert h.view_change_state.req_votes[1] == {1}
+        stale = ReqViewChange(replica_id=2, new_view=0)
+        assert await h.process_message(stale) is False
         return True
 
     assert asyncio.run(scenario())
