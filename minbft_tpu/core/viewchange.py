@@ -14,10 +14,10 @@ closure graph and USIG machinery:
    its complete certified-message log.  Log completeness is enforced by
    USIG itself: the entries' counters must be exactly 1..k with the
    VIEW-CHANGE at k+1 — omitting a sent message leaves a visible gap, so
-   even a faulty quorum member exposes the commit evidence it holds
-   (this is what makes the f+1 quorum of an n = 2f+1 system sufficient).
-3. The new primary (v' mod n) collects f+1 VIEW-CHANGEs and broadcasts a
-   certified NEW-VIEW embedding them.  Every replica derives the same
+   even a faulty quorum member exposes the commit evidence it holds.
+3. The new primary (v' mod n) collects n-f VIEW-CHANGEs (f+1 exactly
+   when n = 2f+1 — see :attr:`ViewChangeState.vc_quorum`) and broadcasts
+   a certified NEW-VIEW embedding them.  Every replica derives the same
    re-proposal set S from those f+1 logs (:func:`compute_new_view_set`),
    enters v', and expects the new primary's first PREPAREs to re-propose
    exactly S in order — a deviation is refused and answered with a demand
@@ -29,10 +29,11 @@ closure graph and USIG machinery:
    without double execution.
 
 Safety sketch: a request executed anywhere needed f+1 commitments; any
-f+1 VIEW-CHANGE quorum intersects that commitment quorum in at least one
-replica, whose log — complete by the counter-gap argument — contains its
-PREPARE/COMMIT for the request, so S re-proposes it before any new
-request, in the original (view, counter) order.
+n-f VIEW-CHANGE quorum intersects that commitment quorum in at least one
+replica ((n-f) + (f+1) = n+1 > n), whose log — complete by the
+counter-gap argument — contains its PREPARE/COMMIT for the request, so S
+re-proposes it before any new request, in the original (view, counter)
+order.
 
 Without checkpoints the VIEW-CHANGE log grows from genesis — the same
 unboundedness as the reference's in-memory message log; checkpointing/GC
@@ -129,20 +130,31 @@ class ViewChangeState:
 
     # -- view-change collection --------------------------------------------
 
+    @property
+    def vc_quorum(self) -> int:
+        """VIEW-CHANGE quorum size: **n - f**, not f+1.  The safety
+        argument needs every view-change quorum to intersect every f+1
+        commitment quorum: (n-f) + (f+1) = n+1 > n guarantees it for ALL
+        n >= 2f+1, while f+1 only suffices at exactly n = 2f+1 (at n=4,
+        f=1 two disjoint pairs could commit and recover separately,
+        forking the ledger).  At n = 2f+1 this reduces to the paper's
+        f+1.  Liveness holds: with <= f crashed, n-f replicas remain."""
+        return self.n - self.f
+
     def record_view_change(self, vc: ViewChange) -> bool:
-        """Record one validated VIEW-CHANGE; True when f+1 distinct
-        replicas' messages for ``vc.new_view`` are available.  Only the
+        """Record one validated VIEW-CHANGE; True when a quorum (n-f
+        distinct replicas) for ``vc.new_view`` is available.  Only the
         first VIEW-CHANGE per (replica, view) counts — USIG counter order
         means every correct replica sees the same first one."""
         per_view = self.view_changes.setdefault(vc.new_view, {})
         per_view.setdefault(vc.replica_id, vc)
-        return len(per_view) >= self.f + 1
+        return len(per_view) >= self.vc_quorum
 
     def quorum_for(self, new_view: int) -> List[ViewChange]:
-        """The deterministic f+1-subset used to build NEW-VIEW: lowest
+        """The deterministic quorum subset used to build NEW-VIEW: lowest
         replica ids first."""
         per_view = self.view_changes.get(new_view, {})
-        picked = sorted(per_view)[: self.f + 1]
+        picked = sorted(per_view)[: self.vc_quorum]
         return [per_view[r] for r in picked]
 
     def prune_through(self, view: int) -> None:
@@ -251,8 +263,11 @@ def make_view_change_validator(verify_ui):
 
 
 def make_new_view_validator(n: int, f: int, verify_ui, validate_view_change):
-    """Validate a NEW-VIEW: sent by the view's primary, carrying f+1
-    distinct valid VIEW-CHANGEs for the same view."""
+    """Validate a NEW-VIEW: sent by the view's primary, carrying n-f
+    distinct valid VIEW-CHANGEs for the same view (see
+    :attr:`ViewChangeState.vc_quorum` for why n-f, not f+1)."""
+
+    quorum = n - f
 
     async def validate_new_view(nv: NewView) -> None:
         if not utils.is_primary(nv.new_view, nv.replica_id, n):
@@ -260,16 +275,25 @@ def make_new_view_validator(n: int, f: int, verify_ui, validate_view_change):
                 "NEW-VIEW from a replica that is not the view's primary"
             )
         senders = {vc.replica_id for vc in nv.view_changes}
-        if len(nv.view_changes) != f + 1 or len(senders) != f + 1:
+        if len(nv.view_changes) != quorum or len(senders) != quorum:
             raise api.AuthenticationError(
-                "NEW-VIEW must carry f+1 distinct VIEW-CHANGEs"
+                "NEW-VIEW must carry n-f distinct VIEW-CHANGEs"
             )
         for vc in nv.view_changes:
             if vc.new_view != nv.new_view:
                 raise api.AuthenticationError(
                     "NEW-VIEW embeds a VIEW-CHANGE for another view"
                 )
-            await validate_view_change(vc)
+        # The per-VC validations are stateless — gather them so the whole
+        # quorum's UI checks co-batch on the verification engine instead
+        # of paying n-f serial engine round-trips during recovery.
+        results = await asyncio.gather(
+            *[validate_view_change(vc) for vc in nv.view_changes],
+            return_exceptions=True,
+        )
+        for res in results:
+            if isinstance(res, BaseException):
+                raise res
         await verify_ui(nv)
 
     return validate_new_view

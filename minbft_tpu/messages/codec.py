@@ -207,16 +207,26 @@ _intern_bytes = 0
 _INTERNABLE = (_TAG_REQUEST, _TAG_PREPARE)
 
 
-def unmarshal(data: bytes) -> Message:
+# Deepest legitimate embedding: NEW-VIEW → VIEW-CHANGE → COMMIT → PREPARE
+# → REQUEST = 5 levels; the cap rejects crafted self-nesting (a ~15KB
+# message of VIEW-CHANGE-in-VIEW-CHANGE would otherwise blow the Python
+# recursion limit before any authentication, and RecursionError is not a
+# CodecError — peers would misclassify it as a local internal bug).
+_MAX_NESTING = 8
+
+
+def unmarshal(data: bytes, _depth: int = 0) -> Message:
     """Parse canonical bytes back into a typed message
     (reference messages.MessageImpl.NewFromBinary, messages/api.go:26)."""
     global _intern_bytes
+    if _depth > _MAX_NESTING:
+        raise CodecError("message nesting too deep")
     if data and data[0] in _INTERNABLE:
         m = _intern.get(data)
         if m is not None:
             _intern.move_to_end(data)
             return m
-    m, off = _unmarshal_at(data, 0)
+    m, off = _unmarshal_at(data, 0, _depth)
     if off != len(data):
         raise CodecError("trailing bytes after message")
     if data[0] in _INTERNABLE and len(data) < _INTERN_MAX_BYTES // 4:
@@ -228,7 +238,7 @@ def unmarshal(data: bytes) -> Message:
     return m
 
 
-def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
+def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
     if off >= len(data):
         raise CodecError("empty message")
     tag = data[off]
@@ -261,7 +271,7 @@ def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
         reqs = []
         for _ in range(count):
             reqb, off = _read_bytes(data, off)
-            req = unmarshal(reqb)
+            req = unmarshal(reqb, depth + 1)
             if not isinstance(req, Request):
                 raise CodecError("PREPARE must embed REQUESTs")
             reqs.append(req)
@@ -272,7 +282,7 @@ def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
         rid, off = _read_u32(data, off)
         prepb, off = _read_bytes(data, off)
         uib, off = _read_bytes(data, off)
-        prep = unmarshal(prepb)
+        prep = unmarshal(prepb, depth + 1)
         if not isinstance(prep, Prepare):
             raise CodecError("COMMIT must embed a PREPARE")
         ui = _parse_ui(uib)
@@ -289,7 +299,7 @@ def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
         entries = []
         for _ in range(count):
             eb, off = _read_bytes(data, off)
-            entry = unmarshal(eb)
+            entry = unmarshal(eb, depth + 1)
             if not isinstance(entry, (Prepare, Commit, ViewChange, NewView)):
                 raise CodecError("VIEW-CHANGE log entries must be certified")
             entries.append(entry)
@@ -309,7 +319,7 @@ def _unmarshal_at(data: bytes, off: int) -> Tuple[Message, int]:
         vcs = []
         for _ in range(count):
             vcb, off = _read_bytes(data, off)
-            vc = unmarshal(vcb)
+            vc = unmarshal(vcb, depth + 1)
             if not isinstance(vc, ViewChange):
                 raise CodecError("NEW-VIEW must embed VIEW-CHANGEs")
             vcs.append(vc)

@@ -26,7 +26,12 @@ class LatencyReservoir:
     total_s: float = 0.0
 
     def __post_init__(self):
+        import random
+
         self._samples: list = []
+        # Fixed seed: percentiles are statistics, but reproducible runs
+        # help debugging.
+        self._rng = random.Random(0x9E3779B97F4A7C15)
 
     def observe(self, seconds: float) -> None:
         self.count += 1
@@ -34,8 +39,13 @@ class LatencyReservoir:
         if len(self._samples) < self.capacity:
             self._samples.append(seconds)
         else:
-            # deterministic decimation: overwrite round-robin
-            self._samples[self.count % self.capacity] = seconds
+            # Algorithm R reservoir sampling: every observation ends up in
+            # the sample with equal probability capacity/count, so a
+            # long-run p99 reflects the whole run (a round-robin overwrite
+            # would be recent-biased — the last `capacity` events only).
+            j = self._rng.randrange(self.count)
+            if j < self.capacity:
+                self._samples[j] = seconds
 
     @property
     def mean_s(self) -> float:

@@ -95,3 +95,20 @@ def test_cluster_populates_counters():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_reservoir_is_uniform_over_the_whole_run():
+    """Long-run percentiles must reflect the full stream, not the last
+    `capacity` events (VERDICT r2: the old round-robin overwrite was
+    recent-biased — a p99 after a slow warm-up read as the steady state)."""
+    from minbft_tpu.utils.metrics import LatencyReservoir
+
+    r = LatencyReservoir(capacity=1000)
+    for _ in range(50_000):
+        r.observe(0.001)
+    for _ in range(50_000):
+        r.observe(0.1)
+    frac_slow = sum(1 for s in r._samples if s > 0.01) / len(r._samples)
+    # uniform => ~0.5; the old recency-biased scheme gave 1.0
+    assert 0.35 < frac_slow < 0.65, frac_slow
+    assert r.count == 100_000 and abs(r.mean_s - 0.0505) < 0.001

@@ -114,33 +114,59 @@ def test_view_change_validator_log_completeness():
 
 
 def test_new_view_validator_quorum_shape():
+    # n=4, f=1: the view-change quorum is n-f = 3, NOT f+1 = 2 — two
+    # disjoint pairs could otherwise commit and recover separately (the
+    # quorum must intersect every f+1 commitment quorum for all n >= 2f+1)
     validate = vc_mod.make_new_view_validator(
         4, 1, _UIOnlyVerifier(), _vc_validator()
     )
-    vc1 = ViewChange(replica_id=2, new_view=1, log=(), ui=UI(counter=1))
-    vc2 = ViewChange(replica_id=3, new_view=1, log=(), ui=UI(counter=1))
-    ok = NewView(replica_id=1, new_view=1, view_changes=(vc1, vc2),
+    vc1 = ViewChange(replica_id=0, new_view=1, log=(), ui=UI(counter=1))
+    vc2 = ViewChange(replica_id=2, new_view=1, log=(), ui=UI(counter=1))
+    vc3 = ViewChange(replica_id=3, new_view=1, log=(), ui=UI(counter=1))
+    ok = NewView(replica_id=1, new_view=1, view_changes=(vc1, vc2, vc3),
                  ui=UI(counter=1))
     asyncio.run(validate(ok))
+    assert vc_mod.ViewChangeState(4, 1, 0).vc_quorum == 3
+    assert vc_mod.ViewChangeState(7, 3, 0).vc_quorum == 4  # n=2f+1: f+1
 
     # must come from view 1's primary (replica 1 of 4)
-    wrong_primary = NewView(replica_id=2, new_view=1, view_changes=(vc1, vc2),
-                            ui=UI(counter=1))
+    wrong_primary = NewView(replica_id=2, new_view=1,
+                            view_changes=(vc1, vc2, vc3), ui=UI(counter=1))
     with pytest.raises(api.AuthenticationError, match="primary"):
         asyncio.run(validate(wrong_primary))
 
-    # f+1 distinct senders required
-    dup = NewView(replica_id=1, new_view=1, view_changes=(vc1, vc1),
+    # an f+1-sized (sub-quorum) set is rejected
+    small = NewView(replica_id=1, new_view=1, view_changes=(vc2, vc3),
+                    ui=UI(counter=1))
+    with pytest.raises(api.AuthenticationError, match="distinct"):
+        asyncio.run(validate(small))
+
+    # distinct senders required
+    dup = NewView(replica_id=1, new_view=1, view_changes=(vc1, vc2, vc2),
                   ui=UI(counter=1))
     with pytest.raises(api.AuthenticationError, match="distinct"):
         asyncio.run(validate(dup))
 
     # embedded VCs must be for the same view
     other = ViewChange(replica_id=3, new_view=2, log=(), ui=UI(counter=1))
-    mixed = NewView(replica_id=1, new_view=1, view_changes=(vc1, other),
+    mixed = NewView(replica_id=1, new_view=1, view_changes=(vc1, vc2, other),
                     ui=UI(counter=1))
     with pytest.raises(api.AuthenticationError, match="another view"):
         asyncio.run(validate(mixed))
+
+
+def test_codec_rejects_nesting_bomb():
+    """Crafted deep self-nesting must fail as a CodecError (a drop), not a
+    RecursionError (which peers would count as a local internal bug)."""
+    from minbft_tpu.messages.codec import CodecError
+
+    p = _prepare(1, primary=1)
+    msg = ViewChange(replica_id=1, new_view=1, log=(p,), ui=UI(counter=2))
+    for _ in range(200):
+        msg = ViewChange(replica_id=1, new_view=1, log=(msg,), ui=UI(counter=2))
+    data = marshal(msg)
+    with pytest.raises(CodecError, match="nesting"):
+        unmarshal(data)
 
 
 def test_trimmed_entries_keep_authen_bytes():
