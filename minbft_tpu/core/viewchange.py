@@ -61,7 +61,7 @@ def batch_key(prepare: Prepare) -> BatchKey:
 def compute_new_view_set(
     view_changes, new_view: int
 ) -> List[Prepare]:
-    """Derive the deterministic re-proposal set S from a NEW-VIEW's f+1
+    """Derive the deterministic re-proposal set S from a NEW-VIEW's n-f
     VIEW-CHANGEs: every PREPARE of a view < new_view appearing in any log
     (directly, or embedded in a COMMIT), ordered by (view, primary CV) and
     deduplicated — USIG uniqueness guarantees one PREPARE per (primary,
