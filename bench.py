@@ -48,6 +48,7 @@ def bench_ecdsa(batch: int, mode: str = "unrolled", prefix: str = "ecdsa") -> di
         d, q = hc.keygen()
         digest = hashlib.sha256(b"bench").digest()
         sig = hc.ecdsa_sign(d, digest)
+        batch = max(batch, 4)  # the corrupted-lane check needs 4 lanes
         items = [(q, digest, sig)] * batch
         arrays = [jax.device_put(jnp.asarray(a)) for a in p256.prepare_batch(items)]
         t0 = time.time()
@@ -105,6 +106,51 @@ def bench_ecdsa_sign(batch: int, mode: str = "block") -> dict:
         "ecdsa_sign_batch": batch,
         "ecdsa_signs_per_sec": batch / dt,
         "ecdsa_sign_compile_s": round(compile_s, 1),
+    }
+
+
+def bench_ed25519(batch: int, mode: str = "block") -> dict:
+    """Batched Ed25519 verification rate (the cfg5 signature scheme's
+    device kernel, measured standalone like the ECDSA headline)."""
+    import secrets
+
+    from minbft_tpu.ops import ed25519 as ed
+    from minbft_tpu.ops import lowering
+    from minbft_tpu.utils import hostcrypto as hc
+
+    lowering.set_mode(mode)
+    try:
+        seed, pub = hc.ed25519_keygen(secrets.token_bytes(32))
+        msg = hashlib.sha256(b"bench-ed").digest()
+        sig = hc.ed25519_sign(seed, msg)
+        batch = max(batch, 4)  # the corrupted-lane check needs 4 lanes
+        items = [(pub, msg, sig)] * batch
+        t0 = time.time()
+        out = np.asarray(ed.verify_batch_padded(items, batch))
+        compile_s = time.time() - t0
+        assert bool(out.all()), "ed25519 self-check failed"
+        bad = items[:4]
+        bad[2] = (pub, msg, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:])
+        res = ed.verify_batch(bad)
+        assert list(res) == [True, True, False, True], "ed25519 corrupted-lane"
+
+        arrays = ed.prepare_batch(items, batch)
+        dev = [jax.device_put(jnp.asarray(a)) for a in arrays]
+        n_iter = 20
+        t0 = time.time()
+        for _ in range(n_iter):
+            out = ed.ed25519_verify_kernel(*dev)
+        res = np.asarray(out)  # see bench_ecdsa timing note
+        dt = (time.time() - t0) / n_iter
+        assert bool(res.all())
+    finally:
+        lowering.set_mode(None)
+    return {
+        "ed25519_batch": batch,
+        "ed25519_mode": mode,
+        "ed25519_ms_per_batch": round(dt * 1e3, 2),
+        "ed25519_verifies_per_sec": batch / dt,
+        "ed25519_compile_s": round(compile_s, 1),
     }
 
 
@@ -365,6 +411,8 @@ def main() -> None:
     extras.update(ecdsa)
     if not os.environ.get("MINBFT_BENCH_SKIP_SIGN"):
         extras.update(bench_ecdsa_sign(min(batch, 2048), mode=mode))
+    if not os.environ.get("MINBFT_BENCH_SKIP_ED25519"):
+        extras.update(bench_ed25519(batch, mode=mode))
     if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
         # BASELINE.md config 3 (the north star): n=7/f=3, 10k requests,
         # ECDSA-P256, COMMIT-phase verification batched on the chip.

@@ -257,6 +257,52 @@ def test_hello_handler_replays_broadcast_and_unicast():
             assert (m.client_id, m.seq) == (5, 9)
 
 
+def test_deviating_reproposal_refused_and_view_change_demanded():
+    """A new primary whose first PREPARE does not match the agreed
+    re-proposal set S is refused, and the replica broadcasts a demand for
+    the next view (the Byzantine-new-primary defense, wired end to end
+    through _process_peer_message)."""
+
+    async def scenario():
+        h = _handlers(replica_id=2)
+        # replica 2 entered view 1 with one expected re-proposal batch
+        await h.view_state.advance_expected_view(1)
+        await h.view_state.advance_current_view(1)
+        expected = Prepare(replica_id=1, view=1, request=_req(client_id=9, seq=1))
+        from minbft_tpu.core.viewchange import batch_key
+
+        h.view_change_state.arm_reproposals(1, [batch_key(expected)])
+
+        applied = []
+
+        async def record_apply(prepare):
+            applied.append(prepare)
+
+        h.apply_prepare = record_apply
+
+        # the (faulty) new primary proposes a different request first
+        deviating = Prepare(
+            replica_id=1, view=1, request=_req(client_id=5, seq=7),
+            ui=UI(counter=1),
+        )
+        assert await h._process_peer_message(deviating) is False
+        assert applied == []
+        demands = [
+            m for m in h.message_log.snapshot() if isinstance(m, ReqViewChange)
+        ]
+        assert [d.new_view for d in demands] == [2]
+
+        # the honest re-proposal (next counter) is accepted
+        honest = Prepare(
+            replica_id=1, view=1, requests=expected.requests, ui=UI(counter=2)
+        )
+        assert await h._process_peer_message(honest) is True
+        assert applied == [honest]
+        return True
+
+    assert asyncio.run(scenario())
+
+
 def test_peer_stream_requires_hello_first():
     async def scenario():
         h = _handlers(replica_id=0)
