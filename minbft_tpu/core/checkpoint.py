@@ -1,0 +1,122 @@
+"""Checkpoint certificates — phase 1 of the checkpointing roadmap item
+(reference README.md:492-493 lists checkpointing/GC as unimplemented; its
+``checkpointPeriod``/``logsize`` config knobs are reserved,
+api/api.go:40-43).
+
+Every ``checkpoint_period`` executed requests, a replica certifies a
+CHECKPOINT carrying its execution count and the state-machine digest
+(:meth:`api.RequestConsumer.state_digest`).  A checkpoint becomes
+**stable** once f+1 distinct replicas certified the same (count, digest):
+at least one of them is correct, so the state at that count is durable
+evidence.  The f+1 messages form the checkpoint certificate — retained so
+the next phase (log truncation + VIEW-CHANGE log scoping, which also
+needs a state-transfer path for lagging replicas) can anchor on it.
+
+Execution order is identical on every correct replica (the commitment
+collector releases strictly in primary-CV order and batches execute in
+batch order), so the execution COUNT is a deterministic global sequence
+number — two correct replicas always agree on the digest at a count, and
+a certified mismatch at the same count is hard evidence of divergence
+(or of a faulty replica's lie about its state), surfaced loudly.
+
+Off by default: ``checkpoint_period = 0`` (the config default) emits
+nothing and changes no behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..messages import Checkpoint
+
+
+class CheckpointCollector:
+    """Tracks peers' certified checkpoints and the stable watermark.
+
+    Memory is O(n): exactly one outstanding claim — the newest — is kept
+    per replica (a faulty replica certifying absurd counts can replace
+    its own claim but never grow state; cf. the repo's protocol-memory
+    bounds).  Quorums still form through stragglers because every honest
+    replica emits every period in order: f+1 replicas' newest claims
+    meet at each period boundary before the frontier moves on."""
+
+    def __init__(self, f: int, logger=None):
+        self.f = f
+        self.log = logger
+        self._claims: Dict[int, Checkpoint] = {}  # replica -> newest claim
+        self.stable_count = 0
+        self.stable_digest: bytes = b""
+        self._stable_cert: List[Checkpoint] = []
+
+    @property
+    def stable_certificate(self) -> List[Checkpoint]:
+        """The f+1 CHECKPOINT messages proving the stable watermark."""
+        return list(self._stable_cert)
+
+    def record(self, cp: Checkpoint) -> bool:
+        """Account one certified CHECKPOINT; True if it (now) makes its
+        (count, digest) stable.  Divergence — certified different digests
+        for one count — is logged loudly: it means a diverged state
+        machine or a lying replica, and an operator must look."""
+        if cp.count <= self.stable_count:
+            return False  # already stable or below the watermark
+        prev = self._claims.get(cp.replica_id)
+        if prev is not None and prev.count >= cp.count:
+            return False  # older (or duplicate) claim from this replica
+        self._claims[cp.replica_id] = cp
+        matching = [
+            c
+            for c in self._claims.values()
+            if c.count == cp.count and c.digest == cp.digest
+        ]
+        divergent = sorted(
+            c.replica_id
+            for c in self._claims.values()
+            if c.count == cp.count and c.digest != cp.digest
+        )
+        if divergent and self.log is not None:
+            self.log.error(
+                "checkpoint divergence at count %d: %s vs replicas %s",
+                cp.count,
+                cp.digest.hex()[:16],
+                divergent,
+            )
+        if len(matching) < self.f + 1:
+            return False
+        self.stable_count = cp.count
+        self.stable_digest = cp.digest
+        self._stable_cert = matching[: self.f + 1]
+        for rid in [
+            r for r, c in self._claims.items() if c.count <= cp.count
+        ]:
+            del self._claims[rid]
+        return True
+
+
+def make_checkpoint_emitter(
+    replica_id: int,
+    period: int,
+    consumer,
+    emit_certified,
+):
+    """Closure run after each executed request: every ``period``
+    executions, certify a CHECKPOINT of the consumer's state digest and
+    hand it to ``emit_certified`` (the Handlers sink, which assigns the
+    UI under its lock and applies the primary gate — see there).
+    ``period <= 0`` disables emission entirely."""
+
+    executed = {"n": 0}
+
+    async def maybe_emit_checkpoint() -> None:
+        executed["n"] += 1
+        if period <= 0 or executed["n"] % period:
+            return
+        await emit_certified(
+            Checkpoint(
+                replica_id=replica_id,
+                count=executed["n"],
+                digest=consumer.state_digest(),
+            )
+        )
+
+    return maybe_emit_checkpoint

@@ -71,6 +71,12 @@ class ViewState:
                 return  # captured event, so the wakeup cannot be missed
             await ev.wait()
 
+    @property
+    def current(self) -> int:
+        """Synchronous current-view read (for non-suspending call sites
+        like the checkpoint emitter's primary check)."""
+        return self._current
+
     async def hold_view(self) -> Tuple[int, int]:
         """-> (current_view, expected_view) snapshot (no lease).  For
         view-sensitive *processing*, use :meth:`hold_view_lease` — a

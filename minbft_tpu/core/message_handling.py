@@ -28,6 +28,8 @@ from typing import AsyncIterator, Dict, Optional
 
 from .. import api
 from ..messages import (
+    CERTIFIED_MESSAGES,
+    Checkpoint,
     Commit,
     Hello,
     Message,
@@ -47,6 +49,7 @@ from . import commit as commit_mod
 from . import prepare as prepare_mod
 from . import request as request_mod
 from . import timeout as timeout_mod
+from . import checkpoint as checkpoint_mod
 from . import usig_ui, utils
 from . import viewchange as viewchange_mod
 from ..utils.metrics import ReplicaMetrics
@@ -338,11 +341,46 @@ class Handlers:
             add_reply,
         )
 
+        # Checkpoint certificates (beyond reference — core/checkpoint.py):
+        # every checkpoint_period executions, certify the consumer's state
+        # digest; f+1 matching claims make the checkpoint stable.
+        self.checkpoint_collector = checkpoint_mod.CheckpointCollector(
+            f, logger=self.log
+        )
+        async def emit_checkpoint(cp) -> None:
+            # The (current or imminent) primary must not emit: a
+            # checkpoint would consume a USIG counter mid-PREPARE-stream,
+            # and the acceptor/release machinery relies on the primary's
+            # prepare CVs being consecutive within a view.  Checked under
+            # the UI lock against BOTH current and expected views — a
+            # NEW-VIEW making this replica primary assigns its UI (the
+            # counter base) before the view advances, and a checkpoint
+            # slipping into that window would split the base sequence.
+            # f+1 matching claims from the n-1 backups still make
+            # checkpoints stable (n-1 >= f+1 for every n >= 2f+1, f >= 1).
+            async with self._ui_lock:
+                cur, exp = await self.view_state.hold_view()
+                if utils.is_primary(cur, replica_id, n) or utils.is_primary(
+                    exp, replica_id, n
+                ):
+                    return
+                self.assign_ui(cp)
+                self.metrics.inc("checkpoints_sent")
+                self.message_log.append(cp)
+
+        maybe_emit_checkpoint = checkpoint_mod.make_checkpoint_emitter(
+            replica_id,
+            getattr(configer, "checkpoint_period", 0),
+            consumer,
+            emit_checkpoint,
+        )
+
         async def execute_counted(req: Request) -> None:
             t0 = time.monotonic()
             await base_execute(req)
             self.metrics.observe_execute(time.monotonic() - t0)
             self.metrics.inc("requests_executed")
+            await maybe_emit_checkpoint()
 
         self.execute_request = execute_counted
 
@@ -424,9 +462,9 @@ class Handlers:
         """Assign a UI under the global UI lock (serialized — USIG counters
         must match log order) and append to the broadcast log."""
         async with self._ui_lock:
-            if isinstance(msg, (Prepare, Commit, ViewChange, NewView)):
-                if msg.ui is None:  # emit_view_change pre-assigns under
-                    self.assign_ui(msg)  # this same lock
+            if isinstance(msg, CERTIFIED_MESSAGES):
+                if msg.ui is None:  # emit_view_change/emit_checkpoint
+                    self.assign_ui(msg)  # pre-assign under this same lock
                 if isinstance(msg, (Prepare, Commit)):
                     self.metrics.inc(
                         "prepares_sent"
@@ -456,6 +494,8 @@ class Handlers:
             await self.validate_view_change(msg)
         elif isinstance(msg, NewView):
             await self.validate_new_view(msg)
+        elif isinstance(msg, Checkpoint):
+            await self.verify_ui(msg)
         else:
             raise api.AuthenticationError(f"unexpected message {stringify(msg)}")
 
@@ -466,7 +506,7 @@ class Handlers:
     async def process_message(self, msg: Message) -> bool:
         if isinstance(msg, Request):
             return await self.process_request(msg)
-        if isinstance(msg, (Prepare, Commit, ViewChange, NewView)):
+        if isinstance(msg, CERTIFIED_MESSAGES):
             return await self._process_peer_message(msg)
         if isinstance(msg, ReqViewChange):
             # Beyond the reference (which refuses here, "Not implemented",
@@ -476,15 +516,25 @@ class Handlers:
         raise ValueError(f"unexpected message {stringify(msg)}")
 
     async def _process_peer_message(self, msg) -> bool:
-        if isinstance(msg, (ViewChange, NewView)):
-            # Certified view-change messages ride the same per-peer
-            # counter-ordered capture, but apply outside the view lease:
-            # NEW-VIEW application *advances* the view, which drains the
-            # lease it would otherwise hold.
+        if isinstance(msg, (ViewChange, NewView, Checkpoint)):
+            # Certified view-change/checkpoint messages ride the same
+            # per-peer counter-ordered capture, but apply outside the view
+            # lease: NEW-VIEW application *advances* the view, which
+            # drains the lease it would otherwise hold, and checkpoints
+            # are view-independent.
             if not await self.capture_ui(msg):
                 return False
             if isinstance(msg, ViewChange):
                 return await self._apply_view_change(msg)
+            if isinstance(msg, Checkpoint):
+                if self.checkpoint_collector.record(msg):
+                    self.metrics.inc("checkpoints_stable")
+                    self.log.info(
+                        "stable checkpoint at %d executions (digest %s)",
+                        self.checkpoint_collector.stable_count,
+                        self.checkpoint_collector.stable_digest.hex()[:12],
+                    )
+                return True
             return await self._apply_new_view(msg)
 
         msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
@@ -608,8 +658,7 @@ class Handlers:
             log = tuple(
                 viewchange_mod.trim_log_entry(m)
                 for m in self.message_log.snapshot()
-                if isinstance(m, (Prepare, Commit, ViewChange, NewView))
-                and m.ui is not None
+                if isinstance(m, CERTIFIED_MESSAGES) and m.ui is not None
             )
             vc = ViewChange(
                 replica_id=self.replica_id, new_view=new_view, log=log
@@ -726,9 +775,7 @@ class Handlers:
         return await self.reply_request(msg)
 
     async def handle_peer_message(self, msg: Message) -> None:
-        if isinstance(
-            msg, (Prepare, Commit, ReqViewChange, ViewChange, NewView, Request)
-        ):
+        if isinstance(msg, (*CERTIFIED_MESSAGES, ReqViewChange, Request)):
             self.metrics.inc("messages_handled")
             try:
                 await self.validate_message(msg)
@@ -760,7 +807,7 @@ class Handlers:
         (reference handleOwnMessage, core/message-handling.go:352-361).
         Own REQ-VIEW-CHANGE/VIEW-CHANGE/NEW-VIEW count toward our own
         quorums the same way peers' do."""
-        if isinstance(msg, (Prepare, Commit, ViewChange, NewView)):
+        if isinstance(msg, CERTIFIED_MESSAGES):
             await self._process_peer_message(msg)
         elif isinstance(msg, ReqViewChange):
             await self._process_req_view_change(msg)

@@ -16,6 +16,7 @@ from .message import (
     Commit,
     Hello,
     Message,
+    Checkpoint,
     NewView,
     Prepare,
     ReqViewChange,
@@ -38,6 +39,7 @@ __all__ = [
     "ReqViewChange",
     "ViewChange",
     "NewView",
+    "Checkpoint",
     "CLIENT_MESSAGES",
     "REPLICA_MESSAGES",
     "PEER_MESSAGES",

@@ -27,6 +27,7 @@ import struct
 
 from . import codec
 from .message import (
+    Checkpoint,
     Commit,
     Message,
     NewView,
@@ -123,6 +124,13 @@ def _authen_bytes(m: Message) -> bytes:
             + _U32.pack(m.replica_id)
             + _U64.pack(m.new_view)
             + collection_digest(m.view_changes, m.vcs_digest)
+        )
+    if isinstance(m, Checkpoint):
+        return (
+            b"CHECKPOINT"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.count)
+            + _sha256(m.digest)
         )
     raise TypeError(f"{type(m).__name__} has no authen bytes")
 

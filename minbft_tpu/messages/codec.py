@@ -23,7 +23,9 @@ from collections import OrderedDict
 from typing import Tuple
 
 from .message import (
+    CERTIFIED_MESSAGES,
     UI,
+    Checkpoint,
     Commit,
     Hello,
     Message,
@@ -44,6 +46,7 @@ _TAG_COMMIT = 0x05
 _TAG_REQ_VIEW_CHANGE = 0x06
 _TAG_VIEW_CHANGE = 0x07
 _TAG_NEW_VIEW = 0x08
+_TAG_CHECKPOINT = 0x09
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
@@ -174,6 +177,14 @@ def marshal(m: Message) -> bytes:
             + _pack_bytes(m.vcs_digest)
             + _pack_ui(m.ui)
         )
+    if isinstance(m, Checkpoint):
+        return (
+            bytes([_TAG_CHECKPOINT])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.count)
+            + _pack_bytes(m.digest)
+            + _pack_ui(m.ui)
+        )
     raise CodecError(f"unknown message type {type(m)!r}")
 
 
@@ -300,7 +311,7 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
         for _ in range(count):
             eb, off = _read_bytes(data, off)
             entry = unmarshal(eb, depth + 1)
-            if not isinstance(entry, (Prepare, Commit, ViewChange, NewView)):
+            if not isinstance(entry, CERTIFIED_MESSAGES):
                 raise CodecError("VIEW-CHANGE log entries must be certified")
             entries.append(entry)
         digest, off = _read_bytes(data, off)
@@ -329,6 +340,17 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
             NewView(
                 replica_id=rid, new_view=nv, view_changes=tuple(vcs),
                 ui=_parse_ui(uib), vcs_digest=digest,
+            ),
+            off,
+        )
+    if tag == _TAG_CHECKPOINT:
+        rid, off = _read_u32(data, off)
+        count, off = _read_u64(data, off)
+        digest, off = _read_bytes(data, off)
+        uib, off = _read_bytes(data, off)
+        return (
+            Checkpoint(
+                replica_id=rid, count=count, digest=digest, ui=_parse_ui(uib)
             ),
             off,
         )

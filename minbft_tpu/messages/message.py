@@ -212,13 +212,32 @@ class NewView(Message):
     vcs_digest: bytes = b""
 
 
+@dataclasses.dataclass
+class Checkpoint(Message):
+    """A replica's certified snapshot claim: after executing ``count``
+    requests its state machine digest is ``digest``.  f+1 matching
+    claims make the checkpoint *stable* (beyond the reference, whose
+    checkpointing is a reserved config knob — README.md:492-493;
+    see :mod:`minbft_tpu.core.checkpoint`)."""
+
+    KIND = "CHECKPOINT"
+    replica_id: int
+    count: int
+    digest: bytes
+    ui: Optional[UI] = None
+
+
 # ---------------------------------------------------------------------------
 # Classification helpers (reference messages/api.go interface hierarchy).
 
 CLIENT_MESSAGES = (Request,)
-REPLICA_MESSAGES = (Reply, Prepare, Commit, ReqViewChange, ViewChange, NewView)
-PEER_MESSAGES = (Prepare, Commit, ReqViewChange, ViewChange, NewView)
-CERTIFIED_MESSAGES = (Prepare, Commit, ViewChange, NewView)  # carry a USIG UI
+REPLICA_MESSAGES = (
+    Reply, Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint,
+)
+PEER_MESSAGES = (Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint)
+CERTIFIED_MESSAGES = (
+    Prepare, Commit, ViewChange, NewView, Checkpoint,
+)  # carry a USIG UI
 SIGNED_MESSAGES = (Request, Reply, ReqViewChange)  # carry a plain signature
 
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from .message import (
+    Checkpoint,
     Commit,
     Hello,
     Message,
@@ -51,5 +52,11 @@ def stringify(m: Message) -> str:
         return (
             f"<NEW-VIEW cv={cv} replica={m.replica_id} "
             f"new_view={m.new_view} vcs={len(m.view_changes)}>"
+        )
+    if isinstance(m, Checkpoint):
+        cv = m.ui.counter if m.ui else None
+        return (
+            f"<CHECKPOINT cv={cv} replica={m.replica_id} "
+            f"count={m.count} digest={m.digest.hex()[:12]}>"
         )
     return f"<{type(m).__name__}>"
