@@ -153,6 +153,16 @@ class Handlers:
         self.peer_states = PeerStates()
         self.view_state = ViewState()
         self.pending = RequestList()
+        # Per-peer view-change bar: highest new_view of a VIEW-CHANGE (or
+        # NEW-VIEW) processed from each peer.  A peer that voted for view
+        # v' froze its log evidence in that VIEW-CHANGE; anything it
+        # certifies *afterwards* for a view < v' is outside every
+        # NEW-VIEW quorum log, so counting it toward a commit quorum
+        # could execute a request the re-proposal set S omits (ledger
+        # fork, reachable at f >= 2 with adversarial delivery).  This is
+        # the receive-side analogue of "stop sending after voting"
+        # (in_transition gates our own sends).  O(n) ints, never pruned.
+        self._peer_vc_bar: Dict[int, int] = {}
         self._ui_lock = asyncio.Lock()
         self.metrics = ReplicaMetrics()
 
@@ -377,7 +387,13 @@ class Handlers:
 
         async def execute_counted(req: Request) -> None:
             t0 = time.monotonic()
-            await base_execute(req)
+            delivered = await base_execute(req)
+            if not delivered:
+                # Already retired (a re-proposed request re-drained after a
+                # view change): counting it would diverge the execution
+                # count — and so the checkpoint sequence — across replicas
+                # that did/didn't execute it pre-transition.
+                return
             self.metrics.observe_execute(time.monotonic() - t0)
             self.metrics.inc("requests_executed")
             await maybe_emit_checkpoint()
@@ -524,6 +540,13 @@ class Handlers:
             # are view-independent.
             if not await self.capture_ui(msg):
                 return False
+            if isinstance(msg, (ViewChange, NewView)):
+                # Raise the sender's bar unconditionally (even for votes
+                # outside the demand window): per-peer capture order means
+                # every later message from this peer was certified after
+                # this vote.
+                if msg.new_view > self._peer_vc_bar.get(msg.replica_id, 0):
+                    self._peer_vc_bar[msg.replica_id] = msg.new_view
             if isinstance(msg, ViewChange):
                 return await self._apply_view_change(msg)
             if isinstance(msg, Checkpoint):
@@ -587,6 +610,12 @@ class Handlers:
                 # stale view, or this replica voted for a view change (the
                 # reference's !active state): captured but not applied —
                 # the transition's VIEW-CHANGE logs carry the evidence.
+                return False
+            if msg_view < self._peer_vc_bar.get(msg.replica_id, 0):
+                # The sender already voted for a higher view: this message
+                # was certified after its VIEW-CHANGE, so no NEW-VIEW
+                # quorum log can contain it — applying it here could
+                # commit a request the re-proposal set S omits.
                 return False
 
             if isinstance(msg, Prepare):

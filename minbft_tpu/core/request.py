@@ -87,11 +87,18 @@ def make_request_executor(
     """Execute a committed REQUEST exactly once (reference
     makeRequestExecutor, core/request.go:211-231): retire the seq (dedup),
     clear timers and pending state, deliver to the state machine, sign and
-    buffer the REPLY."""
+    buffer the REPLY.
 
-    async def execute_request(request: Request) -> None:
+    Returns True iff the request was actually delivered this call.  A
+    re-proposed request re-drained after a view change early-returns False
+    — callers counting executions (metrics, the checkpoint period, which
+    must stay a deterministic global sequence number across replicas) must
+    only count on True, or replicas that executed pre-transition would
+    count a request twice while others count once."""
+
+    async def execute_request(request: Request) -> bool:
         if not retire_seq(request):
-            return  # already executed (reference request.go:214-218)
+            return False  # already executed (reference request.go:214-218)
         pending_requests.remove(request)
         stop_timers(request)
         result = await consumer.deliver(request.operation)
@@ -103,6 +110,7 @@ def make_request_executor(
         )
         sign_message(reply)
         add_reply(reply)
+        return True
 
     return execute_request
 

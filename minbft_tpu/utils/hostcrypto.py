@@ -22,10 +22,15 @@ Two tiers:
   the hot host-side operations (sign/verify/public-key derivation), ~500x
   the pure-Python speed.  ECDSA signing via OpenSSL uses random nonces
   rather than RFC 6979 — both are valid ECDSA; use ``ecdsa_sign_py`` where
-  deterministic output matters.  The Ed25519 *verifier* stays pure-Python
-  by default because its cofactored acceptance semantics (8sB == 8R + 8kA)
-  are the oracle the batch kernel mirrors; OpenSSL's cofactorless check
-  may disagree on adversarial small-order inputs.
+  deterministic output matters.  Ed25519 verification is **strict
+  cofactorless** on every backend — sB == R + kA (the RFC 8032 §5.1.7
+  group equation without the 8× multiplication), which is what OpenSSL
+  implements, what the pure-Python oracle implements, and what the batch
+  kernel (:mod:`minbft_tpu.ops.ed25519`) mirrors bit-for-bit (see the
+  semantics note above ``ed25519_verify_py``).  The agreement matters for
+  BFT: a cofactored verifier disagrees with a strict one on adversarial
+  small-order inputs, and mixed acceptance semantics across replicas
+  would let one crafted signature split the cluster.
 """
 
 from __future__ import annotations

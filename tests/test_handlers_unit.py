@@ -317,3 +317,48 @@ def test_peer_stream_requires_hello_first():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_certified_message_after_peers_view_change_not_applied():
+    """The round-3 advisor's safety hole: a peer that voted (sent a
+    VIEW-CHANGE for v' > v) froze its log evidence in that vote, but its
+    USIG counters stay gap-free — it can certify a view-v COMMIT *after*
+    voting.  A straggler still in view v must not count that commitment
+    toward f+1: no NEW-VIEW quorum log contains it, so the re-proposal set
+    S could omit a request the straggler executed (ledger fork at f >= 2).
+    The per-peer view-change bar refuses exactly these messages."""
+
+    async def scenario():
+        from minbft_tpu.messages import ViewChange
+
+        h = _handlers(replica_id=3)
+        delivered = []
+
+        async def record_execute(req):
+            delivered.append(req)
+
+        h.commitment_collector._execute = record_execute
+
+        # Peer 1 votes for view 1 (its USIG counter 1)...
+        vc = ViewChange(replica_id=1, new_view=1, log=(), ui=UI(counter=1))
+        assert await h._process_peer_message(vc) is True
+
+        # ...then certifies a COMMIT for a view-0 prepare at counter 2.
+        # The primary's PREPARE itself (peer 0, no vote) still applies —
+        # only peer 1's post-vote commitment must be refused.
+        prep = _prepare(cv=1, view=0, primary=0)
+        late_commit = Commit(replica_id=1, prepare=prep, ui=UI(counter=2))
+        assert await h._process_peer_message(late_commit) is False
+
+        # The commitment was not counted: with f=1 the primary's prepare
+        # plus one commit would have completed the quorum and executed.
+        assert delivered == []
+
+        # A commitment from a peer that has NOT voted completes the
+        # quorum as usual (non-regression).
+        ok_commit = Commit(replica_id=2, prepare=prep, ui=UI(counter=1))
+        assert await h._process_peer_message(ok_commit) is True
+        assert [r.seq for r in delivered] == [1]
+        return True
+
+    assert asyncio.run(scenario())
