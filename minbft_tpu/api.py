@@ -160,6 +160,38 @@ class RequestConsumer(abc.ABC):
         """Digest of the current application state
         (reference api/api.go:148-152)."""
 
+    def snapshot(self) -> bytes:
+        """Serialized application state for checkpoint state transfer.
+        Must round-trip: ``install_snapshot(snapshot())`` on a fresh
+        instance yields the same ``state_digest()``.  Optional — but
+        without it the replica keeps its full message log (checkpoints
+        still stabilize; log truncation is disabled, because dropped
+        history could strand a lagging replica that then has no snapshot
+        to catch up from)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state snapshots"
+        )
+
+    def install_snapshot(self, data: bytes) -> None:
+        """Atomically replace the application state with a snapshot.
+        Implementations must validate internal integrity and leave the
+        prior state untouched on failure — the caller verifies
+        ``snapshot_digest`` against an f+1-certified checkpoint digest
+        before installing."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state snapshots"
+        )
+
+    def snapshot_digest(self, data: bytes) -> bytes:
+        """The ``state_digest()`` the snapshot would produce once
+        installed, computed WITHOUT mutating local state — lets a receiver
+        check a transferred snapshot against a certified checkpoint digest
+        before committing to it.  Raises ``ValueError`` on a malformed
+        snapshot."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state snapshots"
+        )
+
 
 class Replica(abc.ABC):
     """A running replica instance (reference api/api.go:155-159)."""

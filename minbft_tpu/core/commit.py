@@ -75,9 +75,14 @@ class CommitmentCollector:
     each replica's CVs are sequential) implies f+1 distinct replicas
     committed this CV.  Nothing grows with the number of requests served."""
 
-    def __init__(self, f: int, execute_request):
+    def __init__(self, f: int, execute_request, on_batch_end=None):
         self._f = f
         self._execute = execute_request
+        # Fired after each batch finishes executing, with (view, cv) — a
+        # deterministic global position, which is what lets checkpoints
+        # (core/checkpoint.py) claim a comparable (count, view, cv) on
+        # every correct replica.  Never fired mid-batch.
+        self._on_batch_end = on_batch_end
         self._lock = asyncio.Lock()
         self._exec_lock = asyncio.Lock()  # serializes state-machine execution
         # acceptor state: per replica, (view, last accepted primary-CV)
@@ -95,6 +100,14 @@ class CommitmentCollector:
         # NEW-VIEW left it (the view-change protocol registers it); view 0
         # starts at 0 (counters begin at 1).
         self._view_base: Dict[int, int] = {0: 0}
+        # stable checkpoint position (view, cv): a per-replica commitment
+        # sequence may JUMP over batches at or below it — the skipped
+        # commits were checkpoint-covered and pruned from the peer's
+        # replayed log, and counting the jumper toward those batches is
+        # sound because the certificate already proves they executed with
+        # real f+1 quorums.  Uncovered gaps remain protocol violations.
+        self._stable_view = 0
+        self._stable_cv = 0
 
     def set_view_base(self, view: int, base_cv: int) -> None:
         """Register the primary-CV base for ``view`` (the NEW-VIEW's own
@@ -111,6 +124,33 @@ class CommitmentCollector:
         refused by the view check anyway.  Called after a view activates."""
         for v in [v for v in self._view_base if v < active_view]:
             del self._view_base[v]
+
+    def note_stable(self, view: int, cv: int) -> None:
+        """Record the stable checkpoint position (enables covered-gap
+        acceptance — see the constructor comment)."""
+        if (view, cv) > (self._stable_view, self._stable_cv):
+            self._stable_view = view
+            self._stable_cv = cv
+
+    def install_checkpoint(self, view: int, cv: int) -> None:
+        """State transfer: resume execution from certified position
+        (view, cv).  Uses the view-base machinery — execution restarts at
+        cv+1 in that view; commitments at or below the position are
+        treated as replays.  Per-peer acceptance state is kept (peers'
+        live commit sequences continued regardless of our jump; covered
+        gaps are tolerated via note_stable)."""
+        self.note_stable(view, cv)
+        if view > self._counter_view:
+            self._counter_view = view
+            self._highest = [0] * self._f
+        self._view_base.setdefault(view, 0)
+        if view in self._next_exec_cv:
+            self._next_exec_cv[view] = max(self._next_exec_cv[view], cv + 1)
+        else:
+            self._next_exec_cv[view] = cv + 1
+        self._ready = {
+            k: p for k, p in self._ready.items() if k > (view, cv)
+        }
 
     def _count(self, view: int, primary_cv: int) -> bool:
         """Reference makeCommitmentCounter (commit.go:177-201): True when
@@ -134,6 +174,12 @@ class CommitmentCollector:
         commit.go:162-166)."""
         view = prepare.view
         primary_cv = prepare.ui.counter
+        if getattr(prepare, "is_stub", False):
+            # Defensive: stubs (checkpoint-covered digests) are captured
+            # but never applied, so this cannot be reached through message
+            # handling — executing one would let full-vs-stub encodings of
+            # one UI diverge replicas.
+            raise api.AuthenticationError("stub PREPARE cannot be committed")
         async with self._lock:
             base = self._view_base.get(view, 0)
             cur_view, last = self._accepted.get(replica_id, (view, base))
@@ -143,10 +189,14 @@ class CommitmentCollector:
                 last = base  # new view: CV numbering restarts from its base
             if primary_cv <= last:
                 return  # replayed commitment — already accounted
-            if primary_cv != last + 1:
+            if primary_cv != last + 1 and (view, primary_cv - 1) > (
+                self._stable_view,
+                self._stable_cv,
+            ):
                 raise api.AuthenticationError(
                     f"replica {replica_id} commitment skips CV "
-                    f"{last + 1} -> {primary_cv}"
+                    f"{last + 1} -> {primary_cv} beyond the stable "
+                    f"checkpoint"
                 )
             self._accepted[replica_id] = (view, primary_cv)
 
@@ -187,6 +237,8 @@ class CommitmentCollector:
                 # back-to-back in batch order on every replica.
                 for req in prepare.requests:
                     await self._execute(req)
+                if self._on_batch_end is not None:
+                    await self._on_batch_end(view, prepare.ui.counter)
 
 
 def make_commitment_collector(

@@ -110,6 +110,24 @@ class ClientState:
     def last_captured_seq(self) -> int:
         return self._last_captured
 
+    @property
+    def retired_seq(self) -> int:
+        return self._retired
+
+    def install_retired_seq(self, seq: int) -> None:
+        """State transfer: adopt a certified retire watermark.  The other
+        lifecycle watermarks advance to match so a re-offered old request
+        dedups instead of re-capturing."""
+        if seq <= self._retired:
+            return
+        self._retired = seq
+        if self._last_captured < seq:
+            if self._last_released == self._last_captured:
+                self._last_released = seq
+            self._last_captured = seq
+        if self._last_prepared < seq:
+            self._last_prepared = seq
+
     # -- reply buffer --------------------------------------------------------
 
     _REPLY_WINDOW = 128  # >= any client pipeline depth; O(1) per client
@@ -184,3 +202,20 @@ class ClientStates:
 
     def all(self):
         return self._clients.items()
+
+    def retire_watermarks(self):
+        """Deterministic snapshot of per-client retire watermarks (sorted
+        (client_id, retired_seq), zero entries omitted) — part of the
+        composite checkpoint digest: the retired set is a pure function of
+        the executed history, so correct replicas agree on it at every
+        batch boundary."""
+        return tuple(
+            (cid, st.retired_seq)
+            for cid, st in sorted(self._clients.items())
+            if st.retired_seq > 0
+        )
+
+    def install_retire_watermarks(self, marks) -> None:
+        """State transfer: adopt certified retire watermarks."""
+        for cid, seq in marks:
+            self.client(cid).install_retired_seq(seq)

@@ -46,6 +46,17 @@ class PeerState:
                 self._next_cv = cv
             self._cond.notify_all()
 
+    async def fast_forward(self, next_cv: int) -> None:
+        """Jump the capture sequence ahead to ``next_cv`` (never back):
+        the peer announced a checkpoint-certified LOG-BASE, so counters
+        below it are intentionally absent from its log — waiting for them
+        would wedge forever.  Wakes gap-parked captures (their counters
+        become replays or ready, per the new base)."""
+        async with self._cond:
+            if next_cv > self._next_cv:
+                self._next_cv = next_cv
+            self._cond.notify_all()
+
 
 class PeerStates:
     """Lazily-populated per-peer map (reference peerstate.go Provider)."""

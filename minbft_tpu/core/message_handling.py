@@ -32,12 +32,15 @@ from ..messages import (
     Checkpoint,
     Commit,
     Hello,
+    LogBase,
     Message,
     NewView,
     Prepare,
     ReqViewChange,
     Reply,
     Request,
+    SnapshotReq,
+    SnapshotResp,
     ViewChange,
     authen_bytes,
     marshal,
@@ -45,6 +48,7 @@ from ..messages import (
     unmarshal,
 )
 from ..messages.codec import CodecError
+from ..messages.authen import collection_digest as authen_collection_digest
 from . import commit as commit_mod
 from . import prepare as prepare_mod
 from . import request as request_mod
@@ -146,6 +150,7 @@ class Handlers:
         self.f = f
         self.configer = configer
         self.authenticator = authenticator
+        self.consumer = consumer
         self.log = logger or utils.make_logger(replica_id)
         self.message_log = message_log
         self.unicast_logs = unicast_logs
@@ -351,38 +356,64 @@ class Handlers:
             add_reply,
         )
 
-        # Checkpoint certificates (beyond reference — core/checkpoint.py):
-        # every checkpoint_period executions, certify the consumer's state
-        # digest; f+1 matching claims make the checkpoint stable.
+        # Checkpointing (phase 1 + 2 — core/checkpoint.py): every
+        # checkpoint_period delivered requests, at a batch boundary, sign
+        # and broadcast a CHECKPOINT of the composite state digest with
+        # per-peer coverage bounds; f+1 matching claims make it stable,
+        # stability licenses log truncation, and the retained snapshot
+        # serves state transfer.  All replicas emit — checkpoints are
+        # signed, not USIG-certified, so the primary's prepare-CV sequence
+        # is untouched.
         self.checkpoint_collector = checkpoint_mod.CheckpointCollector(
             f, logger=self.log
         )
-        async def emit_checkpoint(cp) -> None:
-            # The (current or imminent) primary must not emit: a
-            # checkpoint would consume a USIG counter mid-PREPARE-stream,
-            # and the acceptor/release machinery relies on the primary's
-            # prepare CVs being consecutive within a view.  Checked under
-            # the UI lock against BOTH current and expected views — a
-            # NEW-VIEW making this replica primary assigns its UI (the
-            # counter base) before the view advances, and a checkpoint
-            # slipping into that window would split the base sequence.
-            # f+1 matching claims from the n-1 backups still make
-            # checkpoints stable (n-1 >= f+1 for every n >= 2f+1, f >= 1).
-            async with self._ui_lock:
-                cur, exp = await self.view_state.hold_view()
-                if utils.is_primary(cur, replica_id, n) or utils.is_primary(
-                    exp, replica_id, n
-                ):
-                    return
-                self.assign_ui(cp)
-                self.metrics.inc("checkpoints_sent")
-                self.message_log.append(cp)
+        self.coverage = checkpoint_mod.CoverageTracker()
+        self.validate_checkpoint_cert = checkpoint_mod.make_cert_validator(
+            f, verify_signature
+        )
+        # Own-log truncation state: counters 1..base are dropped from the
+        # broadcast log, vouched by cert (f+1 claims with our coverage
+        # bound >= base).  Mirrored into every VIEW-CHANGE we emit.
+        self._own_log_base: tuple = (0, ())
+        # Execution position (view, cv) at the last batch boundary, and
+        # the pending state-transfer bookkeeping.
+        self._exec_pos = (0, 0)
+        self._snapshot_expect: Optional[Checkpoint] = None
+        self._snapshot_sources: list = []  # claimants left to try
+        self._snapshot_timer = None
+        self._pending_new_view: Optional[NewView] = None
+        self._logsize = getattr(configer, "logsize", 0)
+        # Truncation requires state transfer to exist: dropping/stubbing
+        # covered history strands any replica that later needs it unless
+        # a certified snapshot can replace it.  Consumers without
+        # snapshot support still checkpoint (stability, covered-gap
+        # acceptance) but never GC.
+        self._can_snapshot = (
+            type(consumer).snapshot is not api.RequestConsumer.snapshot
+        )
+        # Swapped + fired whenever the local stable checkpoint advances
+        # (stabilization, LOG-BASE / NEW-VIEW certificate adoption) —
+        # lets stub acceptance wait out the tiny race where a stub task
+        # overtakes the LOG-BASE task on the same stream.
+        self._stable_event = asyncio.Event()
 
-        maybe_emit_checkpoint = checkpoint_mod.make_checkpoint_emitter(
+        async def emit_signed_checkpoint(cp: Checkpoint) -> None:
+            sign_message(cp)
+            self.metrics.inc("checkpoints_sent")
+            # Record our own claim directly (it also rides the broadcast
+            # log to peers; the own-message loop dedups via the
+            # collector's newest-claim rule).
+            if self.checkpoint_collector.record(cp):
+                self._on_checkpoint_stable()
+            self.message_log.append(cp)
+
+        self.checkpoint_emitter = checkpoint_mod.CheckpointEmitter(
             replica_id,
             getattr(configer, "checkpoint_period", 0),
             consumer,
-            emit_checkpoint,
+            client_states.retire_watermarks,
+            self.coverage.bounds_at,
+            emit_signed_checkpoint,
         )
 
         async def execute_counted(req: Request) -> None:
@@ -396,9 +427,13 @@ class Handlers:
                 return
             self.metrics.observe_execute(time.monotonic() - t0)
             self.metrics.inc("requests_executed")
-            await maybe_emit_checkpoint()
+            self.checkpoint_emitter.on_delivered()
 
         self.execute_request = execute_counted
+
+        async def on_batch_end(view: int, cv: int) -> None:
+            self._exec_pos = (view, cv)
+            await self.checkpoint_emitter.on_batch_end(view, cv)
 
         self._prepare_batcher = _PrepareBatcher(
             replica_id,
@@ -427,7 +462,7 @@ class Handlers:
         # --- commit pipeline / quorum (instance kept visible so tests can
         # assert its containers stay bounded)
         self.commitment_collector = commit_mod.CommitmentCollector(
-            f, self.execute_request
+            f, self.execute_request, on_batch_end=on_batch_end
         )
 
         async def collect_counted(peer_id: int, prepare: Prepare) -> None:
@@ -460,7 +495,9 @@ class Handlers:
             n, self.validate_prepare, self.verify_ui
         )
         self.validate_view_change = _cached_validator(
-            viewchange_mod.make_view_change_validator(verify_ui)
+            viewchange_mod.make_view_change_validator(
+                verify_ui, self.validate_checkpoint_cert
+            )
         )
         self.validate_new_view = _cached_validator(
             viewchange_mod.make_new_view_validator(
@@ -510,10 +547,26 @@ class Handlers:
             await self.validate_view_change(msg)
         elif isinstance(msg, NewView):
             await self.validate_new_view(msg)
-        elif isinstance(msg, Checkpoint):
-            await self.verify_ui(msg)
+        elif isinstance(msg, (Checkpoint, SnapshotReq, SnapshotResp)):
+            await self.verify_signature(msg)
+        elif isinstance(msg, LogBase):
+            await self._validate_log_base(msg)
         else:
             raise api.AuthenticationError(f"unexpected message {stringify(msg)}")
+
+    async def _validate_log_base(self, lb: LogBase) -> None:
+        """A LOG-BASE claim is exactly its certificate: f+1 matching
+        signed checkpoints, each attesting a coverage bound for the
+        sender at or above the announced base.  base == 0 is a pure
+        certificate announcement (nothing dropped yet, but the stream
+        carries stubs the certificate covers)."""
+        await self.validate_checkpoint_cert(lb.cert)
+        if lb.base > 0 and min(
+            c.bound_for(lb.replica_id) for c in lb.cert
+        ) < lb.base:
+            raise api.AuthenticationError(
+                "LOG-BASE base exceeds the certified coverage bounds"
+            )
 
     # ------------------------------------------------------------------
     # Processing dispatch (reference processMessage / processPeerMessage /
@@ -529,38 +582,69 @@ class Handlers:
             # core/message-handling.go:419): demands are tallied and f+1
             # of them start the view-change transition.
             return await self._process_req_view_change(msg)
+        if isinstance(msg, Checkpoint):
+            return self._process_checkpoint(msg)
+        if isinstance(msg, LogBase):
+            return await self._process_log_base(msg)
+        if isinstance(msg, SnapshotReq):
+            return await self._process_snapshot_req(msg)
+        if isinstance(msg, SnapshotResp):
+            return await self._process_snapshot_resp(msg)
         raise ValueError(f"unexpected message {stringify(msg)}")
 
     async def _process_peer_message(self, msg) -> bool:
-        if isinstance(msg, (ViewChange, NewView, Checkpoint)):
-            # Certified view-change/checkpoint messages ride the same
-            # per-peer counter-ordered capture, but apply outside the view
-            # lease: NEW-VIEW application *advances* the view, which
-            # drains the lease it would otherwise hold, and checkpoints
-            # are view-independent.
+        if isinstance(msg, (ViewChange, NewView)):
+            # Certified view-change messages ride the same per-peer
+            # counter-ordered capture, but apply outside the view lease:
+            # NEW-VIEW application *advances* the view, which drains the
+            # lease it would otherwise hold.
             if not await self.capture_ui(msg):
                 return False
-            if isinstance(msg, (ViewChange, NewView)):
-                # Raise the sender's bar unconditionally (even for votes
-                # outside the demand window): per-peer capture order means
-                # every later message from this peer was certified after
-                # this vote.
-                if msg.new_view > self._peer_vc_bar.get(msg.replica_id, 0):
-                    self._peer_vc_bar[msg.replica_id] = msg.new_view
+            if self.checkpoint_emitter.period > 0:
+                self.coverage.track(msg.replica_id, msg.ui.counter, msg)
+            # Raise the sender's bar unconditionally (even for votes
+            # outside the demand window): per-peer capture order means
+            # every later message from this peer was certified after
+            # this vote.
+            if msg.new_view > self._peer_vc_bar.get(msg.replica_id, 0):
+                self._peer_vc_bar[msg.replica_id] = msg.new_view
             if isinstance(msg, ViewChange):
                 return await self._apply_view_change(msg)
-            if isinstance(msg, Checkpoint):
-                if self.checkpoint_collector.record(msg):
-                    self.metrics.inc("checkpoints_stable")
-                    self.log.info(
-                        "stable checkpoint at %d executions (digest %s)",
-                        self.checkpoint_collector.stable_count,
-                        self.checkpoint_collector.stable_digest.hex()[:12],
-                    )
-                return True
             return await self._apply_new_view(msg)
 
         msg_view = msg.view if isinstance(msg, Prepare) else msg.prepare.view
+
+        p = msg if isinstance(msg, Prepare) else msg.prepare
+        if p.is_stub:
+            # Checkpoint-covered stub from a truncated log replay: its
+            # counter slot must be captured (gap-free per-peer
+            # sequencing), but it is NEVER applied — executing a stub
+            # would let full-vs-stub encodings of one UI (they share
+            # authen bytes by construction) diverge replicas, and an
+            # up-to-date replica needs nothing from covered history.
+            #
+            # Capture is gated on the LOCAL stable checkpoint actually
+            # covering the stub's batch: an honest sender's stream
+            # carries its LOG-BASE certificate ahead of its stubs (the
+            # short wait absorbs task-ordering races), while a Byzantine
+            # peer stubbing LIVE batches — trying to blind this replica
+            # to a batch by consuming its capture slot with the stub
+            # encoding — is refused without capture, wedging only the
+            # liar's own stream (its un-applied proposals then time out
+            # into a view change).
+            if not await self._wait_covered(p.view, p.ui.counter):
+                raise api.AuthenticationError(
+                    f"stub for uncovered batch (view {p.view} cv "
+                    f"{p.ui.counter}) refused"
+                )
+            if isinstance(msg, Commit):
+                await self._process_peer_message(msg.prepare)
+            if not await self.capture_ui(msg):
+                return False
+            if self.checkpoint_emitter.period > 0:
+                self.coverage.track(msg.replica_id, msg.ui.counter, msg)
+            return False
+
         cur, _ = await self.view_state.hold_view()
         if msg_view > cur:
             # A message from a view this replica hasn't entered yet (its
@@ -599,6 +683,11 @@ class Handlers:
 
         if not await self.capture_ui(msg):
             return False  # already processed (replay)
+        if self.checkpoint_emitter.period > 0:
+            # Coverage bookkeeping feeds checkpoint bounds; with
+            # checkpointing disabled nothing ever prunes it, so don't
+            # let it grow with history.
+            self.coverage.track(msg.replica_id, msg.ui.counter, msg)
 
         # View check + apply under one read lease (reference
         # processViewMessage holds the view, core/message-handling.go:
@@ -631,6 +720,389 @@ class Handlers:
             else:
                 await self.apply_commit(msg)
             return True
+
+    # ------------------------------------------------------------------
+    # Checkpointing: claim accounting, log truncation, state transfer
+    # (phase 2 — core/checkpoint.py).
+
+    def _process_checkpoint(self, cp: Checkpoint) -> bool:
+        coll = self.checkpoint_collector
+        before = coll.cert_version
+        if coll.record(cp):
+            self._on_checkpoint_stable()
+        elif coll.cert_version != before:
+            # A late claim genuinely grew the stable certificate — its
+            # bounds may license a deeper truncation.  (No-op replays and
+            # divergent claims change nothing and cost nothing.)
+            self._maybe_truncate()
+        return True
+
+    def _on_checkpoint_stable(self) -> None:
+        coll = self.checkpoint_collector
+        self.metrics.inc("checkpoints_stable")
+        self._note_stable_locally()
+        self.log.info(
+            "stable checkpoint at %d executions (view %d cv %d, digest %s)",
+            coll.stable_count,
+            coll.stable_view,
+            coll.stable_cv,
+            coll.stable_digest.hex()[:12],
+        )
+        self._maybe_truncate()
+
+    def _note_stable_locally(self) -> None:
+        """Propagate a stable-watermark change: the commitment collector
+        learns the covered-gap position and coverage waiters wake."""
+        coll = self.checkpoint_collector
+        self.commitment_collector.note_stable(
+            coll.stable_view, coll.stable_cv
+        )
+        ev, self._stable_event = self._stable_event, asyncio.Event()
+        ev.set()
+
+    def _adopt_cert(self, cert) -> None:
+        """Adopt an externally received (validated) stable certificate."""
+        coll = self.checkpoint_collector
+        before = coll.stable_count
+        coll.install(cert)
+        if coll.stable_count != before:
+            self._note_stable_locally()
+
+    # Upper bound on the stub coverage wait: honest stubs resolve as soon
+    # as the LOG-BASE earlier on the same stream is adopted (sub-ms);
+    # capping low bounds how long a Byzantine flood of uncovered stubs
+    # can pin bounded-concurrency slots.
+    _STUB_WAIT_CAP_S = 2.0
+
+    async def _wait_covered(self, view: int, cv: int) -> bool:
+        """True once the local stable checkpoint covers batch (view, cv);
+        bounded wait — the honest case resolves as soon as the sender's
+        LOG-BASE certificate (earlier on the same stream) is adopted.
+        Honors a shorter configured view-change timeout (0 = no wait)."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + min(
+            max(self._viewchange_timeout, 0.0), self._STUB_WAIT_CAP_S
+        )
+        while True:
+            coll = self.checkpoint_collector
+            if (view, cv) <= (coll.stable_view, coll.stable_cv):
+                return True
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False
+            ev = self._stable_event
+            try:
+                # No shield: on timeout the inner wait() task must be
+                # cancelled so its waiter leaves the long-lived Event
+                # (a stub flood would otherwise accumulate one leaked
+                # waiter per refusal).
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return False
+
+    def _maybe_truncate(self) -> None:
+        """Garbage-collect the broadcast log against the stable
+        checkpoint: drop the provably-covered prefix (up to the coverage
+        bound β the stable certificate attests for us), stub covered
+        retained entries down to their digests, and install a LOG-BASE
+        head so lagging subscribers fast-forward instead of wedging.
+        Synchronous — atomic with respect to the event loop, so it can
+        never interleave with the UI-locked log snapshot in
+        emit_view_change."""
+        coll = self.checkpoint_collector
+        if coll.stable_count == 0 or not self._can_snapshot:
+            # Without snapshot support there is no state transfer, and
+            # truncated/stubbed history could strand a lagging replica
+            # forever — keep the full log (see api.RequestConsumer).
+            return
+        beta, cert = coll.certificate_for_bound(self.replica_id, self.f + 1)
+        if not cert:
+            return
+        v, cv = coll.stable_view, coll.stable_cv
+        old_base, old_cert = self._own_log_base
+        if beta < old_base:
+            # The fresh certificate's bounds for us lag the base we have
+            # ALREADY committed to (e.g. a new position stabilized first
+            # through replicas that trail our stream): pairing the old
+            # base with a cert that cannot prove it would get our honest
+            # VIEW-CHANGE and LOG-BASE rejected everywhere.  Keep the old
+            # certificate — and cap stubbing at ITS position, since the
+            # head cert must cover every stub a fresh subscriber meets.
+            cert = list(old_cert)
+            beta = old_base
+            v, cv = old_cert[0].view, old_cert[0].cv
+        entries = self.message_log.snapshot()
+        if self._logsize > 0 and len(entries) <= self._logsize:
+            return  # operator asked to retain at least this much history
+        # The droppable prefix: certified entries up to counter β that are
+        # genuinely covered (belt and braces — β is already provably
+        # covered by an honest attester), plus concluded signed messages.
+        n_drop = 0
+        base = self._own_log_base[0]
+        for m in entries:
+            if isinstance(m, CERTIFIED_MESSAGES) and m.ui is not None:
+                cov = checkpoint_mod.entry_coverage(m)
+                if m.ui.counter <= beta and checkpoint_mod.is_covered(
+                    cov, v, cv
+                ):
+                    base = m.ui.counter
+                    n_drop += 1
+                    continue
+                break
+            if isinstance(m, LogBase):
+                n_drop += 1
+                continue
+            if isinstance(m, Checkpoint) and m.count < coll.stable_count:
+                n_drop += 1
+                continue
+            if isinstance(m, ReqViewChange) and m.new_view <= v:
+                n_drop += 1
+                continue
+            break
+        # Stub covered certified entries in the retained suffix (payload
+        # -> digest under the same UI; O(1) per counter slot).
+        stubbed = 0
+        for i, m in enumerate(entries[n_drop:], start=n_drop):
+            if not (isinstance(m, (Prepare, Commit)) and m.ui is not None):
+                continue
+            p = m if isinstance(m, Prepare) else m.prepare
+            if p.is_stub:
+                continue
+            if not checkpoint_mod.is_covered(
+                checkpoint_mod.entry_coverage(m), v, cv
+            ):
+                continue
+            stub_p = Prepare(
+                replica_id=p.replica_id,
+                view=p.view,
+                requests=(),
+                ui=p.ui,
+                requests_digest=authen_collection_digest(p.requests, p.requests_digest),
+            )
+            stub = (
+                stub_p
+                if isinstance(m, Prepare)
+                else Commit(replica_id=m.replica_id, prepare=stub_p, ui=m.ui)
+            )
+            self.message_log.replace(i, stub)
+            stubbed += 1
+        # Always store the freshest certificate THAT PROVES THE BASE
+        # alongside it: our next VIEW-CHANGE must carry a certificate at
+        # the position the retained stubs were covered against, with
+        # coverage bounds for us >= the base (both enforced by every
+        # receiver).  The bound-maximizing cert proves any base <= beta.
+        base = max(base, old_base)
+        self._own_log_base = (base, tuple(cert))
+        head = LogBase(replica_id=self.replica_id, base=base, cert=tuple(cert))
+        if base > old_base:
+            self.metrics.inc("log_truncations")
+            self.message_log.truncate(n_drop, head=head)
+            self.log.info(
+                "log truncated to counter base %d (%d entries dropped, "
+                "%d stubbed) at stable count %d",
+                base,
+                n_drop,
+                stubbed,
+                coll.stable_count,
+            )
+            return
+        # No prefix advance, but the log carries (or just gained) stubs:
+        # the replayed stream's head certificate must cover every stub a
+        # fresh subscriber will meet, or — with f other replicas crashed —
+        # it could never assemble f+1 claims for the stubs' position and
+        # would wedge on the refused stub.  Refresh (or install) the head
+        # in place.
+        cert_pos = cert[0].count if cert else 0
+        old_pos = old_cert[0].count if old_cert else -1
+        head_exists = bool(
+            self.message_log.snapshot()
+        ) and isinstance(self.message_log.snapshot()[0], LogBase)
+        if stubbed or (head_exists and cert_pos > old_pos):
+            if head_exists:
+                self.message_log.replace(0, head)
+            elif base > 0 or stubbed:
+                self.message_log.truncate(0, head=head)
+
+    async def _process_log_base(self, lb: LogBase) -> bool:
+        """A peer announced its log now starts above ``lb.base``
+        (validated: f+1 certificate with coverage bounds >= base).  Adopt
+        the certificate if it is ahead, fetch certified state if *we* are
+        behind it, and fast-forward the peer's capture sequence so its
+        retained suffix doesn't park on the intentional gap."""
+        if lb.replica_id == self.replica_id:
+            return True  # own announcement replayed by the own-message loop
+        cp = lb.cert[0]
+        self._adopt_cert(lb.cert)
+        if self.checkpoint_emitter.count < cp.count:
+            await self._request_state(lb.cert, first_source=lb.replica_id)
+        await self.peer_states.peer(lb.replica_id).fast_forward(lb.base + 1)
+        return True
+
+    async def _request_state(self, cert, first_source: Optional[int] = None) -> None:
+        """Fetch the snapshot at the certificate's checkpoint.  One
+        outstanding target at a time (a newer certificate re-targets);
+        requests rotate through the certificate's claimants on a retry
+        timer, so one dead or snapshot-less responder never wedges the
+        transfer."""
+        cp = cert[0]
+        prev = self._snapshot_expect
+        if prev is not None and prev.count >= cp.count:
+            return
+        self._snapshot_expect = cp
+        sources = [] if first_source in (None, self.replica_id) else [first_source]
+        for c in cert:
+            if c.replica_id != self.replica_id and c.replica_id not in sources:
+                sources.append(c.replica_id)
+        self._snapshot_sources = sources
+        self._send_snapshot_req()
+
+    def _send_snapshot_req(self) -> None:
+        expect = self._snapshot_expect
+        if expect is None or not self._snapshot_sources:
+            return
+        via = self._snapshot_sources.pop(0)
+        self._snapshot_sources.append(via)  # retries cycle the claimants
+        self.metrics.inc("state_transfer_requests")
+        req = SnapshotReq(replica_id=self.replica_id, count=expect.count)
+        self.sign_message(req)
+        ulog = self.unicast_logs.get(via)
+        if ulog is not None:
+            ulog.append(req)
+
+        def on_expiry() -> None:
+            if self._snapshot_expect is not None:
+                self.metrics.inc("state_transfer_retries")
+                self._send_snapshot_req()
+
+        if self._snapshot_timer is not None:
+            self._snapshot_timer.cancel()
+        self._snapshot_timer = self._timer_provider.after(
+            max(self._viewchange_timeout, 1.0), on_expiry
+        )
+
+    async def _process_snapshot_req(self, req: SnapshotReq) -> bool:
+        snap = self.checkpoint_emitter.snapshot_for(req.count)
+        count, cert = req.count, ()
+        if snap is None:
+            # The exact snapshot aged out of the retention window: offer
+            # our newest certified one instead, certificate attached so
+            # the requester can verify and upgrade its target.
+            coll = self.checkpoint_collector
+            if coll.stable_count > req.count:
+                snap = self.checkpoint_emitter.snapshot_for(coll.stable_count)
+                count = coll.stable_count
+                cert = tuple(coll.stable_certificate[: self.f + 1])
+        if snap is None:
+            self.log.info(
+                "no retained snapshot at count %d for replica %d",
+                req.count,
+                req.replica_id,
+            )
+            return False
+        view, cv, app, marks = snap
+        resp = SnapshotResp(
+            replica_id=self.replica_id,
+            count=count,
+            view=view,
+            cv=cv,
+            app_state=app,
+            watermarks=tuple(marks),
+            cert=cert,
+        )
+        self.sign_message(resp)
+        ulog = self.unicast_logs.get(req.replica_id)
+        if ulog is not None:
+            ulog.append(resp)
+        return True
+
+    async def _process_snapshot_resp(self, resp: SnapshotResp) -> bool:
+        """Install a transferred snapshot once it checks out against the
+        f+1-certified composite digest — then jump execution, watermarks,
+        and the view to the certified position and retry any view entry
+        that was waiting on the state."""
+        expect = self._snapshot_expect
+        if expect is None:
+            return False
+        if resp.count == expect.count:
+            target = expect
+        elif resp.count > expect.count and resp.cert:
+            # The responder's retention window moved past our target: it
+            # offered a newer certified snapshot — verify its certificate
+            # independently and upgrade.
+            try:
+                target = await self.validate_checkpoint_cert(resp.cert)
+            except api.AuthenticationError as e:
+                self.log.warning("bad snapshot-upgrade cert: %s", e)
+                return False
+            if (target.count, target.view, target.cv) != (
+                resp.count,
+                resp.view,
+                resp.cv,
+            ):
+                return False
+            self._adopt_cert(resp.cert)
+        else:
+            return False
+        if self.checkpoint_emitter.count >= resp.count:
+            # We caught up past the snapshot while it was in flight (e.g.
+            # replaying full history from an untruncated peer): installing
+            # now would REWIND the application state below the retire
+            # watermarks and diverge this replica forever.
+            self._snapshot_expect = None
+            self._snapshot_sources = []
+            if self._snapshot_timer is not None:
+                self._snapshot_timer.cancel()
+                self._snapshot_timer = None
+            return False
+        try:
+            app_digest = self.consumer.snapshot_digest(resp.app_state)
+        except (ValueError, NotImplementedError) as e:
+            self.log.warning("rejected snapshot at %d: %r", resp.count, e)
+            return False
+        composite = checkpoint_mod.checkpoint_digest(
+            app_digest, resp.count, resp.view, resp.cv, resp.watermarks
+        )
+        if composite != target.digest or (resp.view, resp.cv) != (
+            target.view,
+            target.cv,
+        ):
+            self.log.warning(
+                "snapshot at %d does not match the certified digest "
+                "(from replica %d)",
+                resp.count,
+                resp.replica_id,
+            )
+            return False
+        self.consumer.install_snapshot(resp.app_state)
+        self.client_states.install_retire_watermarks(resp.watermarks)
+        self.commitment_collector.install_checkpoint(resp.view, resp.cv)
+        self.checkpoint_emitter.install(resp.count)
+        self._exec_pos = (resp.view, resp.cv)
+        self._snapshot_expect = None
+        self._snapshot_sources = []
+        if self._snapshot_timer is not None:
+            self._snapshot_timer.cancel()
+            self._snapshot_timer = None
+        self.metrics.inc("state_transfers")
+        self.log.info(
+            "state transfer complete: installed certified state at "
+            "count %d (view %d cv %d) from replica %d",
+            resp.count,
+            resp.view,
+            resp.cv,
+            resp.replica_id,
+        )
+        cur, _ = await self.view_state.hold_view()
+        if resp.view > cur:
+            await self.view_state.advance_expected_view(resp.view)
+            await self.view_state.advance_current_view(resp.view)
+        nv = self._pending_new_view
+        if nv is not None:
+            anchor_count = viewchange_mod.quorum_anchor(nv.view_changes)[0]
+            if self.checkpoint_emitter.count >= anchor_count:
+                self._pending_new_view = None
+                await self._apply_new_view(nv)
+        return True
 
     # ------------------------------------------------------------------
     # View-change protocol steps (beyond reference — core/viewchange.py).
@@ -681,16 +1153,25 @@ class Handlers:
     async def emit_view_change(self, new_view: int) -> None:
         """Build and broadcast this replica's VIEW-CHANGE.  The log
         snapshot and the UI assignment happen under one UI lock hold, so
-        the claimed log is exactly counters 1..k and the VIEW-CHANGE gets
-        k+1 — the contiguity every receiver checks."""
+        the claimed log is exactly counters log_base+1..k and the
+        VIEW-CHANGE gets k+1 — the contiguity every receiver checks.
+        Checkpoint truncation scopes the log: counters at or below the
+        base are vouched by the attached f+1 certificate (coverage bounds
+        >= base), so view-change work is O(checkpoint window), not
+        O(history)."""
         async with self._ui_lock:
+            base, cert = self._own_log_base
             log = tuple(
                 viewchange_mod.trim_log_entry(m)
                 for m in self.message_log.snapshot()
                 if isinstance(m, CERTIFIED_MESSAGES) and m.ui is not None
             )
             vc = ViewChange(
-                replica_id=self.replica_id, new_view=new_view, log=log
+                replica_id=self.replica_id,
+                new_view=new_view,
+                log=log,
+                log_base=base,
+                checkpoint_cert=cert,
             )
             self.assign_ui(vc)
             self.metrics.inc("view_changes_sent")
@@ -730,6 +1211,27 @@ class Handlers:
         cur, _ = await self.view_state.hold_view()
         if nv.new_view <= cur:
             return False
+        anchor_count, av, acv, anchor_cert = viewchange_mod.quorum_anchor(
+            nv.view_changes
+        )
+        if anchor_cert:
+            # The quorum's best certified checkpoint: batches at or below
+            # it are NOT re-proposed — every replica entering the view
+            # must hold that state.  If we are behind it, fetch it first
+            # and re-enter once installed (the NEW-VIEW is already
+            # captured, so it won't be redelivered).
+            self._adopt_cert(anchor_cert)
+            if self.checkpoint_emitter.count < anchor_count:
+                self._pending_new_view = nv
+                self.log.info(
+                    "NEW-VIEW %d anchored at count %d ahead of local %d: "
+                    "state transfer before entering",
+                    nv.new_view,
+                    anchor_count,
+                    self.checkpoint_emitter.count,
+                )
+                await self._request_state(anchor_cert)
+                return False
         s_prepares = viewchange_mod.compute_new_view_set(
             nv.view_changes, nv.new_view
         )
@@ -804,7 +1306,18 @@ class Handlers:
         return await self.reply_request(msg)
 
     async def handle_peer_message(self, msg: Message) -> None:
-        if isinstance(msg, (*CERTIFIED_MESSAGES, ReqViewChange, Request)):
+        if isinstance(
+            msg,
+            (
+                *CERTIFIED_MESSAGES,
+                ReqViewChange,
+                Request,
+                Checkpoint,
+                LogBase,
+                SnapshotReq,
+                SnapshotResp,
+            ),
+        ):
             self.metrics.inc("messages_handled")
             try:
                 await self.validate_message(msg)
@@ -835,11 +1348,15 @@ class Handlers:
         """Own messages replayed from the log are trusted — no validation
         (reference handleOwnMessage, core/message-handling.go:352-361).
         Own REQ-VIEW-CHANGE/VIEW-CHANGE/NEW-VIEW count toward our own
-        quorums the same way peers' do."""
+        quorums the same way peers' do.  Own CHECKPOINTs were already
+        recorded at emission (the collector's newest-claim rule dedups
+        the replay); own LOG-BASE heads are for peers."""
         if isinstance(msg, CERTIFIED_MESSAGES):
             await self._process_peer_message(msg)
         elif isinstance(msg, ReqViewChange):
             await self._process_req_view_change(msg)
+        elif isinstance(msg, Checkpoint):
+            self._process_checkpoint(msg)
 
 
 # ---------------------------------------------------------------------------

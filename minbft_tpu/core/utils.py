@@ -5,7 +5,17 @@ from __future__ import annotations
 import logging
 
 from .. import api
-from ..messages import Commit, Message, Prepare, ReqViewChange, Reply, Request
+from ..messages import (
+    Checkpoint,
+    Commit,
+    Message,
+    Prepare,
+    ReqViewChange,
+    Reply,
+    Request,
+    SnapshotReq,
+    SnapshotResp,
+)
 
 
 def is_primary(view: int, replica_id: int, n: int) -> bool:
@@ -18,7 +28,7 @@ def signing_role(msg: Message) -> api.AuthenticationRole:
     (reference core/utils.go:43-72 message-type → role mapping)."""
     if isinstance(msg, Request):
         return api.AuthenticationRole.CLIENT
-    if isinstance(msg, (Reply, ReqViewChange)):
+    if isinstance(msg, (Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp)):
         return api.AuthenticationRole.REPLICA
     raise TypeError(f"{type(msg).__name__} is not a signed message")
 

@@ -35,9 +35,16 @@ counter-gap argument — contains its PREPARE/COMMIT for the request, so S
 re-proposes it before any new request, in the original (view, counter)
 order.
 
-Without checkpoints the VIEW-CHANGE log grows from genesis — the same
-unboundedness as the reference's in-memory message log; checkpointing/GC
-remains the shared roadmap item.
+Checkpoint scoping (phase 2 — see :mod:`minbft_tpu.core.checkpoint`):
+a VIEW-CHANGE may truncate its log to counters ``log_base+1..k``,
+carrying an f+1 checkpoint certificate whose per-peer coverage bounds
+prove the dropped prefix held no commit evidence beyond the certified
+checkpoint; retained covered entries may be stubbed (payload replaced by
+its digest under the same UI).  The re-proposal set is then **anchored**:
+batches at or below the quorum's best certified position are covered by
+certified state (a lagging replica fetches it — state transfer) and are
+not re-proposed, so view-change work is O(window since the last stable
+checkpoint), not O(history).
 """
 
 from __future__ import annotations
@@ -47,7 +54,7 @@ from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from .. import api
-from ..messages import Commit, NewView, Prepare, ViewChange
+from ..messages import Checkpoint, Commit, NewView, Prepare, ViewChange
 from . import utils
 
 # A batch key: the (client, seq) identity of each request a PREPARE orders.
@@ -58,14 +65,40 @@ def batch_key(prepare: Prepare) -> BatchKey:
     return tuple((r.client_id, r.seq) for r in prepare.requests)
 
 
+def quorum_anchor(view_changes) -> Tuple[int, int, int, Tuple[Checkpoint, ...]]:
+    """The best certified checkpoint position among a VIEW-CHANGE quorum:
+    ``(count, view, cv, certificate)``.  Batches at or below it are
+    covered by certified state; everything above must be re-proposed."""
+    best = (0, 0, 0)
+    cert: Tuple[Checkpoint, ...] = ()
+    for vc in view_changes:
+        if vc.checkpoint_cert:
+            cp = vc.checkpoint_cert[0]
+            if (cp.count, cp.view, cp.cv) > best:
+                best = (cp.count, cp.view, cp.cv)
+                cert = vc.checkpoint_cert
+    return (*best, cert)
+
+
 def compute_new_view_set(
     view_changes, new_view: int
 ) -> List[Prepare]:
     """Derive the deterministic re-proposal set S from a NEW-VIEW's n-f
-    VIEW-CHANGEs: every PREPARE of a view < new_view appearing in any log
-    (directly, or embedded in a COMMIT), ordered by (view, primary CV) and
-    deduplicated — USIG uniqueness guarantees one PREPARE per (primary,
-    counter), so the map cannot collide on conflicting proposals."""
+    VIEW-CHANGEs: every full PREPARE of a view < new_view appearing in
+    any log (directly, or embedded in a COMMIT) whose batch lies **above
+    the quorum anchor**, ordered by (view, primary CV) and deduplicated —
+    USIG uniqueness guarantees one PREPARE per (primary, counter), so the
+    map cannot collide on conflicting proposals.
+
+    Anchored batches are excluded: any request executed at or below the
+    anchor is part of the f+1-certified state every replica entering the
+    view holds (state transfer if behind), and execution order is
+    lexicographic in (view, cv), so a request executed anywhere *above*
+    the anchor has f+1 commitments at a batch above it — evidence the
+    coverage-bound audit guarantees survives in the quorum logs.  Stubs
+    are skipped for the same reason: a stub is validated as covered by
+    its sender's certificate, which the anchor dominates."""
+    _, av, acv, _ = quorum_anchor(view_changes)
     prepares: Dict[Tuple[int, int], Prepare] = {}
     for vc in view_changes:
         for entry in vc.log:
@@ -75,6 +108,8 @@ def compute_new_view_set(
             elif isinstance(entry, Commit):
                 cand = entry.prepare
             if cand is None or cand.ui is None or cand.view >= new_view:
+                continue
+            if cand.is_stub or (cand.view, cand.ui.counter) <= (av, acv):
                 continue
             prepares[(cand.view, cand.ui.counter)] = cand
     return [prepares[k] for k in sorted(prepares)]
@@ -195,13 +230,19 @@ def trim_log_entry(entry):
     linear instead of nesting every earlier log (exponential growth)."""
     from ..messages.authen import collection_digest
 
-    if isinstance(entry, ViewChange) and entry.log:
+    if isinstance(entry, ViewChange) and (entry.log or entry.checkpoint_cert):
         return ViewChange(
             replica_id=entry.replica_id,
             new_view=entry.new_view,
             log=(),
             ui=entry.ui,
             log_digest=collection_digest(entry.log, entry.log_digest),
+            # log_base is part of the authen bytes (it scopes the claimed
+            # history) and must survive trimming; the checkpoint cert is
+            # transferable evidence outside the authen bytes and is
+            # dropped with the log it vouched for.
+            log_base=entry.log_base,
+            checkpoint_cert=(),
         )
     if isinstance(entry, NewView) and entry.view_changes:
         return NewView(
@@ -214,21 +255,52 @@ def trim_log_entry(entry):
     return entry
 
 
-def make_view_change_validator(verify_ui):
+def make_view_change_validator(verify_ui, validate_cert=None):
     """Validate a VIEW-CHANGE: its own UI plus the USIG log-completeness
     invariant — entries are the sender's certified messages with counters
-    exactly 1..k and the VIEW-CHANGE itself at k+1.  Embedded foreign
-    PREPAREs (inside the sender's COMMITs) are verified too, since the
-    re-proposal set derives (view, counter) slots from them."""
+    exactly log_base+1..k and the VIEW-CHANGE itself at k+1.  Embedded
+    foreign PREPAREs (inside the sender's COMMITs) are verified too, since
+    the re-proposal set derives (view, counter) slots from them.
+
+    Checkpoint scoping: a non-zero ``log_base`` requires an f+1
+    checkpoint certificate whose coverage bounds for the sender reach the
+    base (``validate_cert``, see core/checkpoint.py — at least one
+    attester is correct, so the dropped prefix provably holds no evidence
+    beyond the certificate).  Stubbed entries must be covered by the
+    certificate's position — their (view, cv) claims are themselves
+    USIG-authenticated (the digest substitution preserves authen bytes),
+    so a sender cannot stub away live evidence."""
+
+    from . import checkpoint as checkpoint_mod
 
     async def validate_view_change(vc: ViewChange) -> None:
+        cp = None
+        if vc.checkpoint_cert:
+            if validate_cert is None:
+                raise api.AuthenticationError(
+                    "VIEW-CHANGE carries a checkpoint certificate but "
+                    "this validator cannot check one"
+                )
+            cp = await validate_cert(vc.checkpoint_cert)
+        if vc.log_base > 0:
+            if cp is None:
+                raise api.AuthenticationError(
+                    "truncated VIEW-CHANGE without a checkpoint certificate"
+                )
+            bounds = [c.bound_for(vc.replica_id) for c in vc.checkpoint_cert]
+            if min(bounds) < vc.log_base:
+                raise api.AuthenticationError(
+                    "VIEW-CHANGE log_base exceeds the certified coverage "
+                    "bounds: the dropped prefix is not provably covered"
+                )
         checks = []
+        base = vc.log_base
         for i, entry in enumerate(vc.log):
             if entry.replica_id != vc.replica_id:
                 raise api.AuthenticationError(
                     "VIEW-CHANGE log entry from another replica"
                 )
-            if entry.ui is None or entry.ui.counter != i + 1:
+            if entry.ui is None or entry.ui.counter != base + i + 1:
                 raise api.AuthenticationError(
                     "VIEW-CHANGE log has a counter gap: omitted messages"
                 )
@@ -242,19 +314,31 @@ def make_view_change_validator(verify_ui):
                 raise api.AuthenticationError(
                     "NEW-VIEW log entry must be trimmed"
                 )
+            stub = (
+                entry if isinstance(entry, Prepare) else entry.prepare
+            ) if isinstance(entry, (Prepare, Commit)) else None
+            if stub is not None and stub.is_stub:
+                cov = checkpoint_mod.entry_coverage(entry)
+                if cp is None or not checkpoint_mod.is_covered(
+                    cov, cp.view, cp.cv
+                ):
+                    raise api.AuthenticationError(
+                        "VIEW-CHANGE stubs an entry the certificate does "
+                        "not cover"
+                    )
             checks.append(verify_ui(entry))
             if isinstance(entry, Commit):
                 checks.append(verify_ui(entry.prepare))
         # Entry checks are stateless: gather them so they co-batch on the
-        # verification engine (the log grows with history — one serial
-        # engine round-trip per entry would stall recovery; the gather
-        # collapses them to ~one batch, prepare.py's house pattern).
+        # verification engine (the log grows with the checkpoint window —
+        # one serial engine round-trip per entry would stall recovery; the
+        # gather collapses them to ~one batch, prepare.py's house pattern).
         results = await asyncio.gather(*checks, return_exceptions=True)
         for res in results:
             if isinstance(res, BaseException):
                 raise res
         ui = await verify_ui(vc)
-        if ui.counter != len(vc.log) + 1:
+        if ui.counter != base + len(vc.log) + 1:
             raise api.AuthenticationError(
                 "VIEW-CHANGE counter does not extend its log"
             )

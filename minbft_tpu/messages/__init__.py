@@ -15,6 +15,7 @@ from .message import (
     UI,
     Commit,
     Hello,
+    LogBase,
     Message,
     Checkpoint,
     NewView,
@@ -22,6 +23,8 @@ from .message import (
     ReqViewChange,
     Reply,
     Request,
+    SnapshotReq,
+    SnapshotResp,
     ViewChange,
     is_client_message,
     is_peer_message,
@@ -40,6 +43,9 @@ __all__ = [
     "ViewChange",
     "NewView",
     "Checkpoint",
+    "LogBase",
+    "SnapshotReq",
+    "SnapshotResp",
     "CLIENT_MESSAGES",
     "REPLICA_MESSAGES",
     "PEER_MESSAGES",

@@ -35,6 +35,8 @@ from .message import (
     ReqViewChange,
     Reply,
     Request,
+    SnapshotReq,
+    SnapshotResp,
     ViewChange,
 )
 
@@ -82,15 +84,16 @@ def _authen_bytes(m: Message) -> bytes:
     if isinstance(m, Prepare):
         # Covers every embedded request *with* its client signature (in
         # batch order), so the primary's UI authenticates the exact bytes —
-        # and the exact order — it proposed.
-        h = hashlib.sha256()
-        for r in m.requests:
-            h.update(codec.marshal(r))
+        # and the exact order — it proposed.  A checkpoint-covered *stub*
+        # (requests dropped, digest carried) authenticates identically —
+        # and since view sits here in the clear and the counter inside the
+        # UI certificate, a stub's (view, cv) coverage claim is itself
+        # USIG-authenticated.
         return (
             b"PREPARE"
             + _U32.pack(m.replica_id)
             + _U64.pack(m.view)
-            + h.digest()
+            + collection_digest(m.requests, m.requests_digest)
         )
     if isinstance(m, Commit):
         if m.prepare.ui is None:
@@ -106,14 +109,20 @@ def _authen_bytes(m: Message) -> bytes:
     if isinstance(m, ReqViewChange):
         return b"REQ-VIEW-CHANGE" + _U32.pack(m.replica_id) + _U64.pack(m.new_view)
     if isinstance(m, ViewChange):
-        # Covers every log entry *with* its UI (in counter order): the
-        # sender's USIG certifies exactly this claimed history.  A trimmed
-        # copy (empty log, digest carried) authenticates identically, so
-        # the original certificate verifies on it (see ViewChange doc).
+        # Covers every log entry *with* its UI (in counter order) plus the
+        # truncation base: the sender's USIG certifies exactly this claimed
+        # history starting at log_base+1.  The checkpoint certificate is
+        # deliberately NOT covered — it is transferable third-party
+        # evidence the validator checks independently (any f+1 matching
+        # attestation with bounds >= log_base serves), so trimmed copies
+        # may drop it.  A trimmed copy (empty log, digest carried)
+        # authenticates identically, so the original certificate verifies
+        # on it (see ViewChange doc).
         return (
             b"VIEW-CHANGE"
             + _U32.pack(m.replica_id)
             + _U64.pack(m.new_view)
+            + _U64.pack(m.log_base)
             + collection_digest(m.log, m.log_digest)
         )
     if isinstance(m, NewView):
@@ -126,11 +135,32 @@ def _authen_bytes(m: Message) -> bytes:
             + collection_digest(m.view_changes, m.vcs_digest)
         )
     if isinstance(m, Checkpoint):
+        h = hashlib.sha256()
+        for p, b in m.bounds:
+            h.update(_U32.pack(p) + _U64.pack(b))
         return (
             b"CHECKPOINT"
             + _U32.pack(m.replica_id)
             + _U64.pack(m.count)
+            + _U64.pack(m.view)
+            + _U64.pack(m.cv)
             + _sha256(m.digest)
+            + h.digest()
+        )
+    if isinstance(m, SnapshotReq):
+        return b"SNAPSHOT-REQ" + _U32.pack(m.replica_id) + _U64.pack(m.count)
+    if isinstance(m, SnapshotResp):
+        h = hashlib.sha256()
+        for c, s in m.watermarks:
+            h.update(_U32.pack(c) + _U64.pack(s))
+        return (
+            b"SNAPSHOT-RESP"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.count)
+            + _U64.pack(m.view)
+            + _U64.pack(m.cv)
+            + _sha256(m.app_state)
+            + h.digest()
         )
     raise TypeError(f"{type(m).__name__} has no authen bytes")
 

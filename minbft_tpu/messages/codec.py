@@ -28,12 +28,15 @@ from .message import (
     Checkpoint,
     Commit,
     Hello,
+    LogBase,
     Message,
     NewView,
     Prepare,
     ReqViewChange,
     Reply,
     Request,
+    SnapshotReq,
+    SnapshotResp,
     ViewChange,
 )
 
@@ -47,6 +50,9 @@ _TAG_REQ_VIEW_CHANGE = 0x06
 _TAG_VIEW_CHANGE = 0x07
 _TAG_NEW_VIEW = 0x08
 _TAG_CHECKPOINT = 0x09
+_TAG_LOG_BASE = 0x0A
+_TAG_SNAPSHOT_REQ = 0x0B
+_TAG_SNAPSHOT_RESP = 0x0C
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
@@ -141,6 +147,7 @@ def marshal(m: Message) -> bytes:
             + _pack_u64(m.view)
             + _pack_u32(len(m.requests))
             + b"".join(_pack_bytes(marshal(r)) for r in m.requests)
+            + _pack_bytes(m.requests_digest)
             + _pack_ui(m.ui)
         )
     if isinstance(m, Commit):
@@ -165,6 +172,9 @@ def marshal(m: Message) -> bytes:
             + _pack_u32(len(m.log))
             + b"".join(_pack_bytes(marshal(e)) for e in m.log)
             + _pack_bytes(m.log_digest)
+            + _pack_u64(m.log_base)
+            + _pack_u32(len(m.checkpoint_cert))
+            + b"".join(_pack_bytes(marshal(c)) for c in m.checkpoint_cert)
             + _pack_ui(m.ui)
         )
     if isinstance(m, NewView):
@@ -183,7 +193,40 @@ def marshal(m: Message) -> bytes:
             + _pack_u32(m.replica_id)
             + _pack_u64(m.count)
             + _pack_bytes(m.digest)
-            + _pack_ui(m.ui)
+            + _pack_u64(m.view)
+            + _pack_u64(m.cv)
+            + _pack_u32(len(m.bounds))
+            + b"".join(_pack_u32(p) + _pack_u64(b) for p, b in m.bounds)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, LogBase):
+        return (
+            bytes([_TAG_LOG_BASE])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.base)
+            + _pack_u32(len(m.cert))
+            + b"".join(_pack_bytes(marshal(c)) for c in m.cert)
+        )
+    if isinstance(m, SnapshotReq):
+        return (
+            bytes([_TAG_SNAPSHOT_REQ])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.count)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, SnapshotResp):
+        return (
+            bytes([_TAG_SNAPSHOT_RESP])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.count)
+            + _pack_u64(m.view)
+            + _pack_u64(m.cv)
+            + _pack_bytes(m.app_state)
+            + _pack_u32(len(m.watermarks))
+            + b"".join(_pack_u32(c) + _pack_u64(s) for c, s in m.watermarks)
+            + _pack_u32(len(m.cert))
+            + b"".join(_pack_bytes(marshal(c)) for c in m.cert)
+            + _pack_bytes(m.signature)
         )
     raise CodecError(f"unknown message type {type(m)!r}")
 
@@ -277,8 +320,6 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
         rid, off = _read_u32(data, off)
         view, off = _read_u64(data, off)
         count, off = _read_u32(data, off)
-        if count == 0:
-            raise CodecError("PREPARE must embed at least one REQUEST")
         reqs = []
         for _ in range(count):
             reqb, off = _read_bytes(data, off)
@@ -286,9 +327,20 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
             if not isinstance(req, Request):
                 raise CodecError("PREPARE must embed REQUESTs")
             reqs.append(req)
+        rdig, off = _read_bytes(data, off)
+        if count == 0 and not rdig:
+            raise CodecError(
+                "PREPARE must embed at least one REQUEST or a stub digest"
+            )
         uib, off = _read_bytes(data, off)
         ui = _parse_ui(uib)
-        return Prepare(replica_id=rid, view=view, requests=reqs, ui=ui), off
+        return (
+            Prepare(
+                replica_id=rid, view=view, requests=reqs, ui=ui,
+                requests_digest=rdig,
+            ),
+            off,
+        )
     if tag == _TAG_COMMIT:
         rid, off = _read_u32(data, off)
         prepb, off = _read_bytes(data, off)
@@ -315,11 +367,21 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
                 raise CodecError("VIEW-CHANGE log entries must be certified")
             entries.append(entry)
         digest, off = _read_bytes(data, off)
+        base, off = _read_u64(data, off)
+        ccount, off = _read_u32(data, off)
+        cert = []
+        for _ in range(ccount):
+            cb, off = _read_bytes(data, off)
+            cp = unmarshal(cb, depth + 1)
+            if not isinstance(cp, Checkpoint):
+                raise CodecError("VIEW-CHANGE cert entries must be CHECKPOINTs")
+            cert.append(cp)
         uib, off = _read_bytes(data, off)
         return (
             ViewChange(
                 replica_id=rid, new_view=nv, log=tuple(entries),
                 ui=_parse_ui(uib), log_digest=digest,
+                log_base=base, checkpoint_cert=tuple(cert),
             ),
             off,
         )
@@ -347,10 +409,65 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
         rid, off = _read_u32(data, off)
         count, off = _read_u64(data, off)
         digest, off = _read_bytes(data, off)
-        uib, off = _read_bytes(data, off)
+        view, off = _read_u64(data, off)
+        cv, off = _read_u64(data, off)
+        bcount, off = _read_u32(data, off)
+        bounds = []
+        for _ in range(bcount):
+            p, off = _read_u32(data, off)
+            b, off = _read_u64(data, off)
+            bounds.append((p, b))
+        sig, off = _read_bytes(data, off)
         return (
             Checkpoint(
-                replica_id=rid, count=count, digest=digest, ui=_parse_ui(uib)
+                replica_id=rid, count=count, digest=digest, view=view,
+                cv=cv, bounds=tuple(bounds), signature=sig,
+            ),
+            off,
+        )
+    if tag == _TAG_LOG_BASE:
+        rid, off = _read_u32(data, off)
+        base, off = _read_u64(data, off)
+        ccount, off = _read_u32(data, off)
+        cert = []
+        for _ in range(ccount):
+            cb, off = _read_bytes(data, off)
+            cp = unmarshal(cb, depth + 1)
+            if not isinstance(cp, Checkpoint):
+                raise CodecError("LOG-BASE cert entries must be CHECKPOINTs")
+            cert.append(cp)
+        return LogBase(replica_id=rid, base=base, cert=tuple(cert)), off
+    if tag == _TAG_SNAPSHOT_REQ:
+        rid, off = _read_u32(data, off)
+        count, off = _read_u64(data, off)
+        sig, off = _read_bytes(data, off)
+        return SnapshotReq(replica_id=rid, count=count, signature=sig), off
+    if tag == _TAG_SNAPSHOT_RESP:
+        rid, off = _read_u32(data, off)
+        count, off = _read_u64(data, off)
+        view, off = _read_u64(data, off)
+        cv, off = _read_u64(data, off)
+        app, off = _read_bytes(data, off)
+        wcount, off = _read_u32(data, off)
+        marks = []
+        for _ in range(wcount):
+            c, off = _read_u32(data, off)
+            s, off = _read_u64(data, off)
+            marks.append((c, s))
+        ccount, off = _read_u32(data, off)
+        cert = []
+        for _ in range(ccount):
+            cb, off = _read_bytes(data, off)
+            cp = unmarshal(cb, depth + 1)
+            if not isinstance(cp, Checkpoint):
+                raise CodecError("SNAPSHOT-RESP cert entries must be CHECKPOINTs")
+            cert.append(cp)
+        sig, off = _read_bytes(data, off)
+        return (
+            SnapshotResp(
+                replica_id=rid, count=count, view=view, cv=cv,
+                app_state=app, watermarks=tuple(marks), cert=tuple(cert),
+                signature=sig,
             ),
             off,
         )

@@ -111,6 +111,16 @@ class Prepare(Message):
     view: int
     requests: Tuple[Request, ...]
     ui: Optional[UI] = None
+    # Canonical digest of the (possibly stubbed-away) request batch: a
+    # **stub** PREPARE carries ``requests=()`` with this digest filled, and
+    # has the *same* authen bytes as the full original — so the primary's
+    # UI certificate (which also binds view and counter) still verifies on
+    # it.  Stubs appear only inside checkpoint-truncated VIEW-CHANGE logs
+    # and log replays, proving a counter slot's occupant without carrying
+    # the batch content; live processing captures them but never applies
+    # or executes them (a stub reaching execution would let a Byzantine
+    # primary equivocate full-vs-stub under one UI).
+    requests_digest: bytes = b""
 
     def __init__(
         self,
@@ -119,20 +129,32 @@ class Prepare(Message):
         request: Optional[Request] = None,
         ui: Optional[UI] = None,
         requests: Optional[Sequence[Request]] = None,
+        requests_digest: bytes = b"",
     ):
-        if (request is None) == (requests is None):
-            raise ValueError("pass exactly one of request= / requests=")
+        if request is not None and requests is not None:
+            raise ValueError("pass at most one of request= / requests=")
         self.replica_id = replica_id
         self.view = view
-        self.requests = (request,) if request is not None else tuple(requests)
-        if not self.requests:
-            raise ValueError("PREPARE must order at least one request")
+        self.requests = (
+            (request,) if request is not None else tuple(requests or ())
+        )
+        if not self.requests and not requests_digest:
+            raise ValueError(
+                "PREPARE must order at least one request (or be a stub "
+                "carrying the batch digest)"
+            )
         self.ui = ui
+        self.requests_digest = requests_digest
 
     @property
     def request(self) -> Request:
         """The first (often only) request of the batch."""
         return self.requests[0]
+
+    @property
+    def is_stub(self) -> bool:
+        """True for a checkpoint-covered stub (digest kept, batch dropped)."""
+        return not self.requests
 
 
 @dataclasses.dataclass
@@ -192,6 +214,15 @@ class ViewChange(Message):
     # Canonical digest of the (possibly trimmed-away) log contents; filled
     # on the wire so trimmed copies keep the original's authen bytes.
     log_digest: bytes = b""
+    # Checkpoint truncation (phase 2 — core/checkpoint.py): the log may
+    # omit the sender's certified messages with counters <= log_base,
+    # provided checkpoint_cert carries f+1 matching CHECKPOINTs whose
+    # per-peer coverage bounds for this sender are >= log_base — at least
+    # one attester is correct, so the dropped prefix provably holds no
+    # commit evidence beyond the certified checkpoint.  log_base == 0 is
+    # the untruncated (genesis) form.
+    log_base: int = 0
+    checkpoint_cert: Tuple["Checkpoint", ...] = ()
 
 
 @dataclasses.dataclass
@@ -214,17 +245,101 @@ class NewView(Message):
 
 @dataclasses.dataclass
 class Checkpoint(Message):
-    """A replica's certified snapshot claim: after executing ``count``
-    requests its state machine digest is ``digest``.  f+1 matching
-    claims make the checkpoint *stable* (beyond the reference, whose
-    checkpointing is a reserved config knob — README.md:492-493;
-    see :mod:`minbft_tpu.core.checkpoint`)."""
+    """A replica's **signed** snapshot claim: after executing ``count``
+    requests — through batch ``(view, cv)``, which every correct replica
+    reaches with the same deterministic execution history — its composite
+    state digest is ``digest``.  f+1 matching claims on
+    (count, view, cv, digest) make the checkpoint *stable* (beyond the
+    reference, whose checkpointing is a reserved config knob —
+    README.md:492-493; see :mod:`minbft_tpu.core.checkpoint`).
+
+    Signed, not USIG-certified: a checkpoint consumes no USIG counter, so
+    the primary emits them too without splitting its prepare-CV sequence
+    (closing the liveness margin where f crashed backups left only f
+    claims — the round-3 advisor finding), and checkpoint claims never
+    occupy slots in the certified log the view change reasons about.
+
+    ``bounds`` is the sender's per-peer coverage attestation: for each
+    peer p it has processed, the highest own-USIG-counter b such that
+    every certified message of p with counter <= b is *covered* by this
+    checkpoint (its batch executed within (view, cv), or its view-change
+    transition concluded at a view <= view).  f+1 checkpoints each with
+    bounds[p] >= β license p to truncate its log prefix 1..β — the
+    validator-checkable completeness that makes GC safe at n = 2f+1,
+    where quorum intersections can be entirely Byzantine and hiding
+    evidence must be structurally impossible.
+    """
 
     KIND = "CHECKPOINT"
     replica_id: int
     count: int
     digest: bytes
-    ui: Optional[UI] = None
+    view: int = 0
+    cv: int = 0
+    bounds: Tuple[Tuple[int, int], ...] = ()  # sorted (peer_id, bound)
+    signature: bytes = b""
+
+    def bound_for(self, peer_id: int) -> int:
+        for p, b in self.bounds:
+            if p == peer_id:
+                return b
+        return 0
+
+
+@dataclasses.dataclass
+class LogBase(Message):
+    """Log-truncation announcement, streamed first when a replica's
+    broadcast log no longer starts at USIG counter 1: counters 1..base are
+    gone, and ``cert`` (f+1 matching CHECKPOINTs, each with a coverage
+    bound for this sender >= base) proves the dropped prefix held no
+    evidence beyond the certified checkpoint.  Carries no signature of its
+    own — the embedded certificate is the entire claim, and understating
+    ``base`` only withholds the sender's own messages (self-harm).
+
+    A receiver fast-forwards its per-peer counter capture to base+1; if
+    its own execution count is behind the certificate's, it must fetch the
+    certified state first (:class:`SnapshotReq`)."""
+
+    KIND = "LOG-BASE"
+    replica_id: int
+    base: int
+    cert: Tuple[Checkpoint, ...] = ()
+
+
+@dataclasses.dataclass
+class SnapshotReq(Message):
+    """Signed request for the state snapshot at stable checkpoint
+    ``count`` (state transfer, phase 2 of checkpointing).  A responder
+    that no longer retains that exact snapshot may answer with a NEWER
+    certified one, attaching its certificate (see SnapshotResp.cert)."""
+
+    KIND = "SNAPSHOT-REQ"
+    replica_id: int
+    count: int = 0
+    signature: bytes = b""
+
+
+@dataclasses.dataclass
+class SnapshotResp(Message):
+    """Signed state-transfer payload: the application snapshot plus the
+    deterministic protocol watermarks at checkpoint ``count``.  The
+    receiver verifies the composite checkpoint digest recomputed from this
+    payload against an f+1-certified stable digest before installing —
+    the sender's signature authenticates the unicast, the certificate
+    authenticates the *content*.  ``cert`` is attached when the response
+    is for a newer checkpoint than requested (the exact one aged out of
+    the retention window); the receiver validates it independently and
+    upgrades its target."""
+
+    KIND = "SNAPSHOT-RESP"
+    replica_id: int
+    count: int
+    view: int
+    cv: int
+    app_state: bytes
+    watermarks: Tuple[Tuple[int, int], ...] = ()  # sorted (client, retired)
+    cert: Tuple[Checkpoint, ...] = ()
+    signature: bytes = b""
 
 
 # ---------------------------------------------------------------------------
@@ -233,12 +348,16 @@ class Checkpoint(Message):
 CLIENT_MESSAGES = (Request,)
 REPLICA_MESSAGES = (
     Reply, Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint,
+    LogBase, SnapshotReq, SnapshotResp,
 )
-PEER_MESSAGES = (Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint)
-CERTIFIED_MESSAGES = (
-    Prepare, Commit, ViewChange, NewView, Checkpoint,
-)  # carry a USIG UI
-SIGNED_MESSAGES = (Request, Reply, ReqViewChange)  # carry a plain signature
+PEER_MESSAGES = (
+    Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint,
+    LogBase, SnapshotReq, SnapshotResp,
+)
+CERTIFIED_MESSAGES = (Prepare, Commit, ViewChange, NewView)  # carry a USIG UI
+SIGNED_MESSAGES = (
+    Request, Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp,
+)  # carry a plain signature
 
 
 def is_peer_message(m: Message) -> bool:

@@ -6,12 +6,15 @@ from .message import (
     Checkpoint,
     Commit,
     Hello,
+    LogBase,
     Message,
     NewView,
     Prepare,
     ReqViewChange,
     Reply,
     Request,
+    SnapshotReq,
+    SnapshotResp,
     ViewChange,
 )
 
@@ -28,6 +31,11 @@ def stringify(m: Message) -> str:
         )
     if isinstance(m, Prepare):
         cv = m.ui.counter if m.ui else None
+        if m.is_stub:
+            return (
+                f"<PREPARE-STUB cv={cv} replica={m.replica_id} "
+                f"view={m.view} digest={m.requests_digest.hex()[:12]}>"
+            )
         reqs = ", ".join(stringify(r) for r in m.requests)
         return (
             f"<PREPARE cv={cv} replica={m.replica_id} view={m.view} "
@@ -54,9 +62,20 @@ def stringify(m: Message) -> str:
             f"new_view={m.new_view} vcs={len(m.view_changes)}>"
         )
     if isinstance(m, Checkpoint):
-        cv = m.ui.counter if m.ui else None
         return (
-            f"<CHECKPOINT cv={cv} replica={m.replica_id} "
-            f"count={m.count} digest={m.digest.hex()[:12]}>"
+            f"<CHECKPOINT replica={m.replica_id} count={m.count} "
+            f"view={m.view} cv={m.cv} digest={m.digest.hex()[:12]}>"
+        )
+    if isinstance(m, LogBase):
+        return (
+            f"<LOG-BASE replica={m.replica_id} base={m.base} "
+            f"cert={len(m.cert)}>"
+        )
+    if isinstance(m, SnapshotReq):
+        return f"<SNAPSHOT-REQ replica={m.replica_id} count={m.count}>"
+    if isinstance(m, SnapshotResp):
+        return (
+            f"<SNAPSHOT-RESP replica={m.replica_id} count={m.count} "
+            f"view={m.view} cv={m.cv} state={len(m.app_state)}B>"
         )
     return f"<{type(m).__name__}>"

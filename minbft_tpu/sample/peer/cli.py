@@ -330,7 +330,11 @@ def _run_testnet_scaffold(args) -> int:
         "protocol": {
             "n": args.replicas,
             "f": f,
-            "checkpointPeriod": 0,
+            # Checkpointing on by default: every 128 executions the
+            # replicas certify state, GC their logs behind the stable
+            # certificate, and serve state transfer (override with
+            # CONSENSUS_CHECKPOINT_PERIOD; 0 disables).
+            "checkpointPeriod": 128,
             "logsize": 0,
             "batchsizePrepare": 64,
             "timeout": {"request": "8s", "prepare": "4s", "viewchange": "8s"},

@@ -53,3 +53,65 @@ class SimpleLedger(api.RequestConsumer):
 
     def block(self, height: int) -> Optional[Block]:
         return self._blocks[height] if 0 <= height < len(self._blocks) else None
+
+    # -- checkpoint state transfer ------------------------------------------
+
+    def snapshot(self) -> bytes:
+        """Serialize the whole chain (sample-grade; a production state
+        machine would snapshot compactly).  Round-trips through
+        :meth:`install_snapshot` to an identical ``state_digest``."""
+        out = [struct.pack(">I", len(self._blocks))]
+        for b in self._blocks:
+            out.append(
+                struct.pack(">Q", b.height)
+                + b.prev_hash
+                + struct.pack(">I", len(b.payload))
+                + b.payload
+            )
+        return b"".join(out)
+
+    def snapshot_digest(self, data: bytes) -> bytes:
+        """Digest the snapshot would produce once installed — parse and
+        chain-verify without touching local state (api.RequestConsumer
+        contract: lets state transfer check against a certified
+        checkpoint digest before committing)."""
+        return self._parse_and_verify(data)[-1].digest()
+
+    def install_snapshot(self, data: bytes) -> None:
+        """Parse and hash-chain-verify a snapshot, then swap atomically —
+        the prior state survives any malformed/inconsistent payload."""
+        self._blocks = self._parse_and_verify(data)
+
+    def _parse_and_verify(self, data: bytes) -> List[Block]:
+        try:
+            (count,) = struct.unpack_from(">I", data, 0)
+            off = 4
+            blocks: List[Block] = []
+            for _ in range(count):
+                (height,) = struct.unpack_from(">Q", data, off)
+                off += 8
+                prev_hash = data[off : off + 32]
+                if len(prev_hash) != 32:
+                    raise ValueError("truncated prev_hash")
+                off += 32
+                (plen,) = struct.unpack_from(">I", data, off)
+                off += 4
+                payload = data[off : off + plen]
+                if len(payload) != plen:
+                    raise ValueError("truncated payload")
+                off += plen
+                blocks.append(Block(height, prev_hash, payload))
+            if off != len(data):
+                raise ValueError("trailing bytes")
+        except struct.error as e:
+            raise ValueError(f"malformed ledger snapshot: {e}") from e
+        if not blocks:
+            raise ValueError("empty ledger snapshot")
+        for i, b in enumerate(blocks):
+            if b.height != i:
+                raise ValueError("non-sequential block heights")
+            if i and b.prev_hash != blocks[i - 1].digest():
+                raise ValueError("broken hash chain in snapshot")
+        if blocks[0].prev_hash != b"\x00" * 32 or blocks[0].payload != b"genesis":
+            raise ValueError("snapshot genesis mismatch")
+        return blocks

@@ -1,17 +1,25 @@
-"""Checkpoint-certificate tests (beyond the reference, whose checkpointing
-is a reserved config knob): emission cadence, f+1 stability, divergence
-surfacing, and the in-process cluster reaching a stable checkpoint."""
+"""Checkpoint tests (beyond the reference, whose checkpointing is a
+reserved config knob): claim matching on the full (count, view, cv,
+digest) position, certificate growth for the truncation audit, coverage
+bookkeeping, batch-boundary emission, and the in-process cluster reaching
+a stable checkpoint with every replica (primary included) emitting."""
 
 import asyncio
 
 from conftest import make_cluster
-from minbft_tpu.core.checkpoint import CheckpointCollector, make_checkpoint_emitter
-from minbft_tpu.messages import UI, Checkpoint
+from minbft_tpu.core.checkpoint import (
+    CheckpointCollector,
+    CheckpointEmitter,
+    CoverageTracker,
+    checkpoint_digest,
+)
+from minbft_tpu.messages import UI, Checkpoint, Commit, Prepare, Request
 
 
-def _cp(replica, count, digest=b"d" * 32, cv=1):
+def _cp(replica, count, digest=b"d" * 32, view=0, cv=0, bounds=()):
     return Checkpoint(
-        replica_id=replica, count=count, digest=digest, ui=UI(counter=cv)
+        replica_id=replica, count=count, digest=digest, view=view, cv=cv,
+        bounds=tuple(bounds), signature=b"sig",
     )
 
 
@@ -22,8 +30,7 @@ def test_collector_stability_at_f_plus_1():
     assert col.record(_cp(1, 4)) is True  # f+1 = 2 matching
     assert col.stable_count == 4
     assert {c.replica_id for c in col.stable_certificate} == {0, 1}
-    # at/below the watermark: ignored
-    assert col.record(_cp(2, 4)) is False
+    # below the watermark: ignored
     assert col.record(_cp(2, 3)) is False
     # next period
     assert col.record(_cp(2, 8)) is False
@@ -38,32 +45,98 @@ def test_collector_divergent_digests_never_combine():
     # the first one's quorum
     assert col.record(_cp(1, 4, digest=b"b" * 32)) is False
     assert col.stable_count == 0
+    # neither does a different (view, cv) claim for the same digest
+    assert col.record(_cp(3, 4, digest=b"a" * 32, cv=9)) is False
+    assert col.stable_count == 0
     # a genuine match still stabilizes
     assert col.record(_cp(2, 4, digest=b"a" * 32)) is True
     assert col.stable_digest == b"a" * 32
 
 
-def test_emitter_cadence_and_disable():
+def test_collector_certificate_grows_and_bounds_audit():
+    """Late matching claims at the stable count keep growing the
+    certificate, and certificate_for_bound picks the f+1 subset proving
+    the deepest truncation base for a given replica."""
+    col = CheckpointCollector(f=1)
+    col.record(_cp(0, 4, bounds=[(2, 10)]))
+    col.record(_cp(1, 4, bounds=[(2, 3)]))
+    assert col.stable_count == 4
+    # replica 2's provable base: the 2nd-largest attested bound = 3
+    beta, cert = col.certificate_for_bound(2, quorum=2)
+    assert beta == 3 and len(cert) == 2
+    # a straggler's matching claim with a higher bound arrives late
+    col.record(_cp(3, 4, bounds=[(2, 8)]))
+    beta, cert = col.certificate_for_bound(2, quorum=2)
+    assert beta == 8
+    assert all(c.bound_for(2) >= 8 for c in cert)
+
+
+def test_coverage_tracker_bounds():
+    """Bounds advance past covered entries and stop before the first
+    uncovered one — the validator-checkable truncation audit."""
+    t = CoverageTracker()
+    req = Request(client_id=1, seq=1, operation=b"x")
+    prep_cv1 = Prepare(replica_id=0, view=0, request=req, ui=UI(counter=1))
+    prep_cv9 = Prepare(replica_id=0, view=0, request=req, ui=UI(counter=9))
+    # peer 1: commits to batches cv=1 (counter 1) then cv=9 (counter 2),
+    # then its view-change for view 1 (counter 3)
+    t.track(1, 1, Commit(replica_id=1, prepare=prep_cv1, ui=UI(counter=1)))
+    t.track(1, 2, Commit(replica_id=1, prepare=prep_cv9, ui=UI(counter=2)))
+    from minbft_tpu.messages import ViewChange
+
+    t.track(1, 3, ViewChange(replica_id=1, new_view=1, log=(), ui=UI(counter=3)))
+    # checkpoint at (view 0, cv 5): covers counter 1 only — the commit to
+    # cv=9 blocks, so the bound stops at 1
+    assert t.bounds_at(0, 5) == ((1, 1),)
+    # checkpoint at (view 0, cv 9): covers the second commit, but the
+    # view-1 transition has not concluded at view 0
+    assert t.bounds_at(0, 9) == ((1, 2),)
+    # checkpoints running in view 1 cover the concluded transition too
+    assert t.bounds_at(1, 9) == ((1, 3),)
+
+
+def test_emitter_cadence_batch_boundaries_and_disable():
     async def scenario():
         emitted = []
 
         class Consumer:
             def state_digest(self):
-                return b"digest-%d" % len(emitted)
+                return b"digest"
 
-        async def handle_generated(msg):
-            emitted.append(msg)
+            def snapshot(self):
+                return b"snap"
 
-        emit = make_checkpoint_emitter(0, 2, Consumer(), handle_generated)
-        for _ in range(5):
-            await emit()
-        assert [m.count for m in emitted] == [2, 4]
-        assert all(isinstance(m, Checkpoint) for m in emitted)
+        async def emit(cp):
+            emitted.append(cp)
+
+        em = CheckpointEmitter(
+            0, 2, Consumer(), lambda: ((1, 5),), lambda v, c: (), emit
+        )
+        # three deliveries, then a batch boundary: ONE checkpoint at the
+        # boundary count (3), never mid-batch
+        for _ in range(3):
+            em.on_delivered()
+        await em.on_batch_end(0, 1)
+        assert [m.count for m in emitted] == [3]
+        assert emitted[0].digest == checkpoint_digest(b"digest", 3, 0, 1, ((1, 5),))
+        # the snapshot at the emission position is retained for transfer
+        assert em.snapshot_for(3) == (0, 1, b"snap", ((1, 5),))
+        # count 4 crosses the next multiple of the period -> emits
+        em.on_delivered()
+        await em.on_batch_end(0, 2)
+        assert [m.count for m in emitted] == [3, 4]
+        # count 5 crosses none -> no emission
+        em.on_delivered()
+        await em.on_batch_end(0, 3)
+        assert [m.count for m in emitted] == [3, 4]
 
         emitted.clear()
-        off = make_checkpoint_emitter(0, 0, Consumer(), handle_generated)
+        off = CheckpointEmitter(
+            0, 0, Consumer(), lambda: (), lambda v, c: (), emit
+        )
         for _ in range(5):
-            await off()
+            off.on_delivered()
+        await off.on_batch_end(0, 5)
         assert emitted == []
         return True
 
@@ -71,9 +144,6 @@ def test_emitter_cadence_and_disable():
 
 
 def test_cluster_reaches_stable_checkpoints():
-    # Also the primary-gate regression: if the view-0 primary emitted
-    # checkpoints, its prepare-CV sequence would gap and the cluster
-    # would stall after the first checkpoint period (seen live).
     async def scenario():
         from minbft_tpu.client import new_client
         from minbft_tpu.sample.config import SimpleConfiger
@@ -102,6 +172,13 @@ def test_cluster_reaches_stable_checkpoints():
                 r.handlers.checkpoint_collector.stable_digest for r in replicas
             }
             assert len(digests) == 1  # everyone stabilized the same state
+            # every replica emitted, the primary included (signed
+            # checkpoints consume no USIG counter, so the prepare-CV
+            # sequence is untouched)
+            assert all(
+                r.handlers.metrics.counters.get("checkpoints_sent", 0) > 0
+                for r in replicas
+            )
         finally:
             await client.stop()
             for r in replicas:

@@ -1,0 +1,205 @@
+"""Checkpoint phase 2 done-criteria (the reference's top roadmap item,
+README.md:492-493): logs stay O(checkpoint window) under sustained
+traffic, view changes after long histories ship bounded VIEW-CHANGE
+messages (scoped by the checkpoint certificate instead of re-shipping
+genesis), and a replica with no state joins the cluster through
+certified state transfer."""
+
+import asyncio
+
+import pytest
+
+from conftest import make_cluster
+from minbft_tpu.messages import ViewChange, marshal
+
+
+async def _commit(client, count, tag=b"op"):
+    for k in range(count):
+        r = await asyncio.wait_for(client.request(tag + b"-%d" % k), 30)
+        assert r
+
+
+def test_log_stays_bounded_under_checkpointed_traffic():
+    """With checkpoint_period=10, 150 serial requests leave every
+    replica's broadcast log at O(window) — the covered prefix is dropped
+    behind the stable certificate (without GC each replica's own log
+    would hold one certified entry per request)."""
+
+    async def scenario():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+
+        cfg = SimpleConfiger(
+            n=4, f=1, checkpoint_period=10,
+            timeout_request=60.0, timeout_prepare=30.0,
+        )
+        replicas, c_auths, stubs, ledgers = await make_cluster(n=4, f=1, cfg=cfg)
+        client = new_client(0, 4, 1, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        try:
+            await _commit(client, 150)
+            # let the final checkpoint round settle
+            await asyncio.sleep(0.3)
+            for r in replicas:
+                h = r.handlers
+                assert h.metrics.counters.get("log_truncations", 0) > 0, (
+                    f"replica {r.id} never truncated"
+                )
+                # own log held ~150 certified entries without GC; with a
+                # 10-request window it must stay a small multiple of it
+                assert len(h.message_log) < 60, (
+                    f"replica {r.id} log has {len(h.message_log)} entries"
+                )
+                assert h._own_log_base[0] > 0
+        finally:
+            await client.stop()
+            for r in replicas:
+                await r.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_view_change_after_checkpointing_is_scoped():
+    """After 60 checkpointed requests, a primary crash recovers through
+    VIEW-CHANGEs that carry a truncation base + certificate and a log
+    bounded by the checkpoint window — not the 60-request history — and
+    the cluster commits in the new view."""
+
+    async def scenario():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+
+        cfg = SimpleConfiger(
+            n=4, f=1, checkpoint_period=10,
+            timeout_request=0.8, timeout_prepare=0.4, timeout_viewchange=3.0,
+        )
+        replicas, c_auths, stubs, ledgers = await make_cluster(n=4, f=1, cfg=cfg)
+        client = new_client(0, 4, 1, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        try:
+            await _commit(client, 60)
+            stubs[0].crash()
+            await replicas[0].stop()
+            r1 = await asyncio.wait_for(client.request(b"after-crash"), 30)
+            assert r1
+
+            vcs = [
+                m
+                for r in replicas[1:]
+                for m in r.handlers.message_log.snapshot()
+                if isinstance(m, ViewChange)
+            ]
+            assert vcs, "no VIEW-CHANGE found in any survivor log"
+            for vc in vcs:
+                assert vc.log_base > 0, "VIEW-CHANGE shipped from genesis"
+                assert vc.checkpoint_cert, "truncated VIEW-CHANGE without cert"
+                # the log covers the post-checkpoint window, not history:
+                # ~60 committed requests would mean >120 entries untruncated
+                assert len(vc.log) < 60, f"unscoped log: {len(vc.log)} entries"
+                assert len(marshal(vc)) < 64 * 1024, "oversized VIEW-CHANGE"
+            # steady state in the new view
+            r2 = await asyncio.wait_for(client.request(b"steady"), 30)
+            assert r2
+        finally:
+            await client.stop()
+            for r in replicas[1:]:
+                await r.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_wiped_replica_joins_via_state_transfer():
+    """A replica with no state (never ran; peers have already truncated
+    the history it would need) joins the cluster: LOG-BASE announcements
+    fast-forward its per-peer capture, the certified snapshot installs
+    the application state + watermarks, and it then executes live traffic
+    to the same state digest as the rest."""
+
+    async def scenario():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.core import new_replica
+        from minbft_tpu.sample.authentication import new_test_authenticators
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import (
+            InProcessClientConnector,
+            InProcessPeerConnector,
+            make_testnet_stubs,
+        )
+        from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+        n, f = 4, 1
+        cfg = SimpleConfiger(
+            n=n, f=f, checkpoint_period=10,
+            timeout_request=60.0, timeout_prepare=30.0,
+        )
+        r_auths, c_auths = new_test_authenticators(n, n_clients=1, usig_kind="hmac")
+        stubs = make_testnet_stubs(n)
+        ledgers = [SimpleLedger() for _ in range(n)]
+        replicas = []
+        for i in range(n - 1):  # replica 3 stays offline
+            r = new_replica(
+                i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
+            )
+            stubs[i].assign_replica(r)
+            replicas.append(r)
+        for r in replicas:
+            await r.start()
+        client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        late = None
+        try:
+            await _commit(client, 40)
+            await asyncio.sleep(0.3)
+            # peers truncated the history replica 3 would need
+            assert all(
+                r.handlers._own_log_base[0] > 0 for r in replicas
+            ), "peers never truncated; the join below would not need transfer"
+
+            # replica 3 joins from nothing
+            late = new_replica(
+                3, cfg, r_auths[3], InProcessPeerConnector(stubs), ledgers[3]
+            )
+            stubs[3].assign_replica(late)
+            await late.start()
+
+            deadline = asyncio.get_running_loop().time() + 20
+            while asyncio.get_running_loop().time() < deadline:
+                if late.handlers.metrics.counters.get("state_transfers", 0):
+                    break
+                await asyncio.sleep(0.05)
+            assert late.handlers.metrics.counters.get("state_transfers", 0), (
+                "late replica never completed state transfer"
+            )
+
+            # it now follows live traffic to the same state
+            await _commit(client, 10, tag=b"post-join")
+            deadline = asyncio.get_running_loop().time() + 20
+            target = None
+            while asyncio.get_running_loop().time() < deadline:
+                target = replicas[0].handlers.consumer.state_digest()
+                if (
+                    ledgers[3].length > 0
+                    and ledgers[3].state_digest() == target
+                    and all(
+                        lg.state_digest() == target for lg in ledgers[1:3]
+                    )
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert ledgers[3].state_digest() == target, (
+                f"late replica at {ledgers[3].length} blocks, "
+                f"digest mismatch"
+            )
+        finally:
+            await client.stop()
+            for r in replicas:
+                await r.stop()
+            if late is not None:
+                await late.stop()
+        return True
+
+    assert asyncio.run(scenario())

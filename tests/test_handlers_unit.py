@@ -362,3 +362,34 @@ def test_certified_message_after_peers_view_change_not_applied():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_live_stub_for_uncovered_batch_refused_without_capture():
+    """The stub-blinding defense: a Byzantine primary could send one
+    replica the STUB encoding of a live PREPARE (same authen bytes, same
+    UI) to consume its capture slot and blind it to the batch.  A stub
+    whose batch the local stable checkpoint does not cover must be
+    refused WITHOUT capturing — the full version still processes."""
+
+    async def scenario():
+        from minbft_tpu.messages.authen import collection_digest
+
+        h = _handlers(replica_id=2)
+        h._viewchange_timeout = 0.0  # don't wait around in the test
+
+        full = _prepare(cv=1, view=0, primary=0)
+        stub = Prepare(
+            replica_id=0,
+            view=0,
+            requests=(),
+            ui=UI(counter=1),
+            requests_digest=collection_digest(full.requests, b""),
+        )
+        with pytest.raises(api.AuthenticationError):
+            await h._process_peer_message(stub)
+
+        # the capture slot was NOT consumed: the full PREPARE applies
+        assert await h._process_peer_message(full) is True
+        return True
+
+    assert asyncio.run(scenario())
