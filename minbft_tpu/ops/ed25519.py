@@ -259,13 +259,49 @@ def prepare_batch(
     return ax, ay, u1, u2, ry, rsign, valid
 
 
+# Packed I/O (see ops/p256.py PACKED_COLS note): one u16 upload per
+# dispatch instead of seven array RPCs — limb values are 16-bit by
+# construction, rsign/valid are 0/1.
+
+PACKED_COLS = 5 * limbs.NLIMBS + 2  # ax ay u1 u2 ry | rsign valid
+
+
+def pack_arrays(arrays) -> np.ndarray:
+    ax, ay, u1, u2, ry, rsign, valid = arrays
+    return np.concatenate(
+        [
+            ax, ay, u1, u2, ry,
+            rsign[:, None].astype(np.uint32),
+            valid[:, None].astype(np.uint32),
+        ],
+        axis=1,
+    ).astype(np.uint16)
+
+
+def _verify_one_packed(row: jnp.ndarray) -> jnp.ndarray:
+    r32 = row.astype(jnp.uint32)
+    L_ = limbs.NLIMBS
+    return _verify_one(
+        r32[0:L_],
+        r32[L_ : 2 * L_],
+        r32[2 * L_ : 3 * L_],
+        r32[3 * L_ : 4 * L_],
+        r32[4 * L_ : 5 * L_],
+        r32[5 * L_],
+        r32[5 * L_ + 1] != 0,
+    )
+
+
+ed25519_verify_kernel_packed = per_mode_jit(jax.vmap(_verify_one_packed))
+
+
 def verify_batch_padded(
     items: Sequence[Tuple[bytes, bytes, bytes]], bucket: int
 ) -> np.ndarray:
     """Engine dispatch hook: prepare on host, verify on device -> [bucket]
-    bool (lanes past len(items) are padding)."""
-    arrays = prepare_batch(items, bucket)
-    return np.asarray(ed25519_verify_kernel(*[jnp.asarray(a) for a in arrays]))
+    bool (lanes past len(items) are padding).  Packed single-upload path."""
+    packed = pack_arrays(prepare_batch(items, bucket))
+    return np.asarray(ed25519_verify_kernel_packed(jnp.asarray(packed)))
 
 
 def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:

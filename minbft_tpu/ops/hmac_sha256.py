@@ -65,6 +65,16 @@ def hmac_verify_kernel(keys, msgs, macs):
 
 
 @per_mode_jit
+def hmac_verify_kernel_packed(packed):
+    """Packed single-upload form: [B, 24] u32 rows (key | msg | mac) —
+    one host->device RPC per dispatch instead of three (see the packed
+    note in ops/p256.py)."""
+    return hmac32_verify_batch(
+        packed[:, 0:8], packed[:, 8:16], packed[:, 16:24]
+    )
+
+
+@per_mode_jit
 def hmac_sign_kernel(keys, msgs):
     """Batched MAC generation (used by the software USIG and tests)."""
     return hmac32_batch(keys, msgs)

@@ -312,6 +312,46 @@ def verify_batch(
 ecdsa_verify_kernel = _verify_batch  # the raw jitted batch entry point
 
 
+# Packed I/O: on tunnel-attached hosts each host->device array is its own
+# RPC (~15-20ms); the 8-argument form pays 8 of them per dispatch, which
+# dominated the e2e dispatch round trip (round-4 profile).  One u16 row per
+# lane — limb values are 16-bit by construction, flags are 0/1 — makes the
+# upload a single transfer at half the bytes.
+
+PACKED_COLS = 6 * limbs.NLIMBS + 2  # qx qy u1 u2 r r2 | r2_ok valid
+
+
+def pack_arrays(arrays) -> np.ndarray:
+    """prepare_batch output -> [B, PACKED_COLS] u16 (one upload)."""
+    qx, qy, u1, u2, rr, r2, r2_ok, valid = arrays
+    return np.concatenate(
+        [
+            qx, qy, u1, u2, rr, r2,
+            r2_ok[:, None].astype(np.uint32),
+            valid[:, None].astype(np.uint32),
+        ],
+        axis=1,
+    ).astype(np.uint16)
+
+
+def _verify_one_packed(row: jnp.ndarray) -> jnp.ndarray:
+    r32 = row.astype(jnp.uint32)
+    L = limbs.NLIMBS
+    return _verify_one(
+        r32[0:L],
+        r32[L : 2 * L],
+        r32[2 * L : 3 * L],
+        r32[3 * L : 4 * L],
+        r32[4 * L : 5 * L],
+        r32[5 * L : 6 * L],
+        r32[6 * L] != 0,
+        r32[6 * L + 1] != 0,
+    )
+
+
+ecdsa_verify_kernel_packed = per_mode_jit(jax.vmap(_verify_one_packed))
+
+
 # ---------------------------------------------------------------------------
 # Batched signing.
 #
@@ -332,7 +372,10 @@ def _kg_one(k: jnp.ndarray) -> jnp.ndarray:
     ladder's G+Q table build or its Fermat inversion (~10% of the verify's
     multiplies) and a 2-way instead of 4-way addend select.  Returns X and
     Z (Jacobian, Montgomery form) stacked as one [2, 16] array — a single
-    device→host transfer per batch; Y is not needed for signing."""
+    device→host transfer per batch; Y is not needed for signing.
+
+    Kept as the differential reference for the comb kernel below (and the
+    fallback if a backend dislikes the comb's table selects)."""
     bits = _bits_of(k)
 
     def body(i, carry):
@@ -352,7 +395,119 @@ def _kg_one(k: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([limbs.fe_to_array(res.x), limbs.fe_to_array(z)])
 
 
-ecdsa_kg_kernel = per_mode_jit(jax.vmap(_kg_one))
+ecdsa_kg_ladder_kernel = per_mode_jit(jax.vmap(_kg_one))
+
+
+# --- fixed-base comb --------------------------------------------------------
+#
+# k*G with G fixed admits a precomputed-table comb that the general ladder
+# cannot use: write k = sum_j k_j * 16^j over 64 nibble windows and
+# precompute T[j][v] = v * 16^j * G (affine, Montgomery domain) ON THE HOST
+# — then k*G = sum_j T[j][k_j] is just 64 mixed additions with NO doublings
+# (~7x fewer field multiplies than the 256 double+add ladder).  The
+# windowed approach measured as a dead end for the VERIFY ladder (see
+# _shamir's note) fails on per-lane runtime tables; here the table is one
+# global compile-time constant shared by every lane, and each window's
+# lookup is an elementwise masked sum over 16 rows — no gathers, nothing
+# per-lane resident across the loop.
+
+_COMB_WINDOWS = 64
+_COMB_TABLE_NP: np.ndarray | None = None
+
+
+def _comb_table_np() -> np.ndarray:
+    """[64, 16, 2, NLIMBS] u32: T[j][v] = affine(v * 16^j * G), Montgomery
+    domain; the v=0 rows are zeros (skipped via the q_inf flag).  Built
+    once with host big-int affine arithmetic (~1k cheap ops)."""
+    global _COMB_TABLE_NP
+    if _COMB_TABLE_NP is not None:
+        return _COMB_TABLE_NP
+
+    def aff_add(p1, p2):
+        if p1 is None:
+            return p2
+        (x1, y1), (x2, y2) = p1, p2
+        if x1 == x2:
+            if (y1 + y2) % P == 0:
+                return None
+            lam = (3 * x1 * x1 - 3) * pow(2 * y1, -1, P) % P
+        else:
+            lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+        x3 = (lam * lam - x1 - x2) % P
+        return x3, (lam * (x1 - x3) - y1) % P
+
+    tab = np.zeros((_COMB_WINDOWS, 16, 2, limbs.NLIMBS), np.uint32)
+    base = (GX, GY)  # 16^j * G for the current window
+    for j in range(_COMB_WINDOWS):
+        acc = None
+        for v in range(1, 16):
+            acc = aff_add(acc, base)
+            x, y = acc
+            tab[j, v, 0] = to_limbs((x << 256) % P)
+            tab[j, v, 1] = to_limbs((y << 256) % P)
+        for _ in range(4):  # base <- 16 * base
+            base = aff_add(base, base)
+    _COMB_TABLE_NP = tab
+    return tab
+
+
+def _kg_comb_one(k: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """Scalar-shaped k*G via the fixed-base comb (see the note above).
+    Returns the same [2, 16] (X, Z) stack as _kg_one, narrowed to uint16
+    (limbs are 16-bit; on tunnel-attached hosts the device→host transfer
+    is a first-order cost and this halves it).
+
+    Exceptional-case note: partial sums after window j are m*G with
+    m < 16^(j+1), while window j+1 adds k_{j+1} * 16^(j+1) * G — the
+    incomplete madd's p == ±q cases would need m == ±k_{j+1}*16^(j+1)
+    (mod n), impossible for honest scalars < n; exc is still folded to
+    Z = 0 (host-signer fallback) as defense in depth."""
+    # limb i (16 bits) holds nibble windows 4i..4i+3
+    shifts = (4 * jnp.arange(4, dtype=jnp.uint32))[None, :]
+    nibs = ((k[:, None] >> shifts) & 0xF).reshape(_COMB_WINDOWS)
+
+    def body(j, carry):
+        acc, exc = carry
+        tab_j = lax.dynamic_index_in_dim(table, j, keepdims=False)  # [16,2,L]
+        v = lax.dynamic_index_in_dim(nibs, j, keepdims=False)
+        mask = (jnp.arange(16, dtype=jnp.uint32) == v)[:, None, None]
+        sel = jnp.sum(jnp.where(mask, tab_j, 0), axis=0)  # [2, L]
+        ax = fe_from_array(sel[0])
+        ay = fe_from_array(sel[1])
+        res, e = _madd(acc, ax, ay, v == 0)
+        return res, exc | e
+
+    start = Point(mont_one(FIELD), mont_one(FIELD), limbs.fe_zero())
+    res, exc = lax.fori_loop(
+        0, _COMB_WINDOWS, body, (start, jnp.bool_(False))
+    )
+    z = fe_select(exc, limbs.fe_zero(), res.z)
+    out = jnp.stack([limbs.fe_to_array(res.x), limbs.fe_to_array(z)])
+    return out.astype(jnp.uint16)
+
+
+_kg_comb_batch = None
+
+
+def ecdsa_kg_kernel(k_arr) -> jnp.ndarray:
+    """Batched k*G — fixed-base comb kernel (the sign hot path).  Takes
+    [B, 16] limb rows (any integer dtype; values < 2^16), uploads them as
+    uint16, and returns [B, 2, 16] uint16 (X, Z) Jacobian Montgomery.
+    The comb table is closed over as a jit constant — baked into the
+    executable, never a per-call transfer."""
+    global _kg_comb_batch
+    if _kg_comb_batch is None:
+        table = jnp.asarray(_comb_table_np())
+
+        def _kg_comb_widen(k16: jnp.ndarray) -> jnp.ndarray:
+            # Widen the u16 upload on device; the wire carries half the
+            # bytes of u32 limb rows.
+            return jax.vmap(_kg_comb_one, in_axes=(0, None))(
+                k16.astype(jnp.uint32), table
+            )
+
+        _kg_comb_batch = per_mode_jit(_kg_comb_widen)
+    return _kg_comb_batch(jnp.asarray(np.asarray(k_arr).astype(np.uint16)))
 
 
 def _batch_inv(vals: list, mod: int) -> list:
@@ -376,6 +531,7 @@ def sign_batch(
     items: Sequence[Tuple[int, bytes]],
     bucket: int = 0,
     kg_kernel=None,
+    chunk: int = 4096,
 ) -> list:
     """[(private scalar d, digest32)] -> [(r, s)] — RFC 6979 deterministic,
     byte-identical to :func:`minbft_tpu.utils.hostcrypto.ecdsa_sign_py`.
@@ -390,9 +546,20 @@ def sign_batch(
     from ..utils import hostcrypto as hc
 
     b = len(items)
-    pad = max(bucket, b) - b
+    if b == 0 and bucket == 0:
+        return []
+    total = max(bucket, b)
+    # Pipeline large batches through the device in fixed-size chunks: jax
+    # dispatch is asynchronous, so launching every chunk before collecting
+    # any overlaps chunk i's compute + device->host transfer with chunk
+    # i+1's upload — on tunnel-attached chips the transfers are a
+    # first-order cost and a monolithic batch serializes them.  Equal
+    # chunk shapes share one compiled kernel.
+    if total > chunk:
+        total = -(-total // chunk) * chunk  # round up to a chunk multiple
+    pad = total - b
     ks = []
-    k_arr = np.zeros((b + pad, limbs.NLIMBS), np.uint32)
+    k_arr = np.zeros((total, limbs.NLIMBS), np.uint32)
     for i, (d, digest) in enumerate(items):
         z = int.from_bytes(digest[:32], "big") % N
         k = hc._rfc6979_k(d, z)
@@ -401,7 +568,9 @@ def sign_batch(
     if pad:
         k_arr[b:, 0] = 1  # k = 1: a valid lane, result discarded
     kernel = kg_kernel if kg_kernel is not None else ecdsa_kg_kernel
-    xz = np.asarray(kernel(jnp.asarray(k_arr))).astype("<u2")
+    step = chunk if total > chunk else total
+    outs = [kernel(k_arr[c0 : c0 + step]) for c0 in range(0, total, step)]
+    xz = np.concatenate([np.asarray(o) for o in outs]).astype("<u2")
     xz = xz[:b]  # [B,2,16]
     # Vectorized limb→int: uint16 rows → little-endian bytes → one
     # int.from_bytes per row (a per-limb shift-sum costs ~250us/row).

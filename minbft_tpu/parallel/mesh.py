@@ -89,32 +89,47 @@ def sharded_verifier(scalar_verify: Callable, mesh: Mesh, n_args: int):
 
 
 def sharded_ecdsa_kernel(mesh: Mesh):
-    """Batched ECDSA-P256 verify sharded across ``mesh``
-    (8 limb-array arguments, see :func:`minbft_tpu.ops.p256.prepare_batch`)."""
+    """Batched ECDSA-P256 verify sharded across ``mesh`` — packed
+    single-upload form ([B, PACKED_COLS] u16, see
+    :func:`minbft_tpu.ops.p256.pack_arrays`): the batch axis partitions
+    over the mesh; trailing columns replicate per lane."""
     from ..ops import p256
 
-    return sharded_verifier(p256._verify_one, mesh, 8)
+    return sharded_verifier(p256._verify_one_packed, mesh, 1)
 
 
 def sharded_hmac_kernel(mesh: Mesh):
-    """Batched HMAC-SHA256 verify sharded across ``mesh``."""
-    from ..ops.hmac_sha256 import hmac32_verify
+    """Batched HMAC-SHA256 verify sharded across ``mesh`` (packed
+    [B, 24] u32 rows)."""
+    from ..ops import hmac_sha256 as hs
 
-    return sharded_verifier(hmac32_verify, mesh, 3)
+    def one(row):
+        return hs.hmac32_verify(row[0:8], row[8:16], row[16:24])
+
+    return sharded_verifier(one, mesh, 1)
 
 
 def sharded_ed25519_kernel(mesh: Mesh):
-    """Batched Ed25519 verify sharded across ``mesh`` (7 limb-array
-    arguments, see :func:`minbft_tpu.ops.ed25519.prepare_batch`)."""
+    """Batched Ed25519 verify sharded across ``mesh`` — packed
+    single-upload form (see :func:`minbft_tpu.ops.ed25519.pack_arrays`)."""
     from ..ops import ed25519 as ed
 
-    return sharded_verifier(ed._verify_one, mesh, 7)
+    return sharded_verifier(ed._verify_one_packed, mesh, 1)
 
 
 def sharded_ecdsa_sign_kernel(mesh: Mesh):
     """Batched fixed-base k*G (the device half of ECDSA signing,
     :func:`minbft_tpu.ops.p256.sign_batch`) sharded across ``mesh``:
-    takes [B, 16] nonce limbs, returns [B, 2, 16] X/Z limbs."""
+    takes [B, 16] nonce limbs, returns [B, 2, 16] X/Z limbs (uint16).
+    Uses the fixed-base comb kernel; its precomputed table is a
+    compile-time constant replicated on every device."""
+    import jax.numpy as jnp
+
     from ..ops import p256
 
-    return sharded_verifier(p256._kg_one, mesh, 1)
+    table = jnp.asarray(p256._comb_table_np())
+
+    def kg_one(k):
+        return p256._kg_comb_one(k.astype(jnp.uint32), table)
+
+    return sharded_verifier(kg_one, mesh, 1)

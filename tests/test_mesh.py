@@ -48,9 +48,9 @@ def test_sharded_ecdsa_kernel(mesh8, ecdsa_kernel):
     sig = hc.ecdsa_sign(d, digest)
     items = [(q, digest, sig)] * batch
     items[5] = (q, digest, (sig[0], sig[1] ^ 2))  # corrupted lane
-    args = tuple(jnp.asarray(a) for a in p256.prepare_batch(items))
+    packed = jnp.asarray(p256.pack_arrays(p256.prepare_batch(items)))
 
-    out = np.asarray(ecdsa_kernel(*args))
+    out = np.asarray(ecdsa_kernel(packed))
 
     expected = np.ones(batch, dtype=bool)
     expected[5] = False
@@ -64,11 +64,13 @@ def test_sharded_hmac_kernel(mesh8):
     msgs = jnp.asarray(rng.integers(0, 2**32, (batch, 8), dtype=np.uint32))
     macs = hmac_sign_kernel(keys, msgs)
     kernel = mesh_mod.sharded_hmac_kernel(mesh8)
-    assert np.asarray(kernel(keys, msgs, macs)).all()
+    packed = jnp.concatenate([keys, msgs, jnp.asarray(macs)], axis=1)
+    assert np.asarray(kernel(packed)).all()
 
     bad = np.asarray(macs).copy()
     bad[3, 0] ^= 1
-    out = np.asarray(kernel(keys, msgs, jnp.asarray(bad)))
+    packed_bad = jnp.concatenate([keys, msgs, jnp.asarray(bad)], axis=1)
+    out = np.asarray(kernel(packed_bad))
     expected = np.ones(batch, dtype=bool)
     expected[3] = False
     assert (out == expected).all()
@@ -88,8 +90,8 @@ def test_sharded_output_matches_host(mesh8, ecdsa_kernel):
             sig = (sig[0], sig[1] ^ 1)
         items.append((q, digest, sig))
         expected.append(hc.ecdsa_verify(q, digest, sig))
-    args = tuple(jnp.asarray(a) for a in p256.prepare_batch(items))
-    out = np.asarray(ecdsa_kernel(*args))
+    packed = jnp.asarray(p256.pack_arrays(p256.prepare_batch(items)))
+    out = np.asarray(ecdsa_kernel(packed))
     assert out.tolist() == expected
 
 
