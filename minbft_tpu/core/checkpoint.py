@@ -139,6 +139,16 @@ def is_covered(coverage, view: int, cv: int) -> bool:
     return key[0] <= view  # a concluded transition
 
 
+def _bounds_dominate(new: Checkpoint, prev: Checkpoint) -> bool:
+    """True iff ``new``'s coverage bounds are >= ``prev``'s for every peer
+    prev attests, and strictly better somewhere (or attest new peers)."""
+    prev_b = dict(prev.bounds)
+    new_b = dict(new.bounds)
+    if any(new_b.get(p, 0) < b for p, b in prev_b.items()):
+        return False
+    return new_b != prev_b
+
+
 class CheckpointCollector:
     """Tracks signed checkpoint claims, the stable watermark, and the
     growing stable certificate the truncation audit draws bounds from.
@@ -203,7 +213,13 @@ class CheckpointCollector:
                 cp.cv,
             ) == (self.stable_view, self.stable_cv):
                 prev = self._stable_cert.get(cp.replica_id)
-                if prev is None or cp.bounds != prev.bounds:
+                # Replace only when the new claim's bounds DOMINATE the
+                # stored one's: signed claims are replayable, and an
+                # older replayed claim must neither shrink the provable
+                # truncation base nor churn cert_version (a Byzantine
+                # peer alternating two replays would otherwise force a
+                # full log scan per message).
+                if prev is None or _bounds_dominate(cp, prev):
                     self._stable_cert[cp.replica_id] = cp
                     self.cert_version += 1
             elif self.log is not None:

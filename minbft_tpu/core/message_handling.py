@@ -651,18 +651,30 @@ class Handlers:
             # NEW-VIEW is still in flight): park until the transition
             # catches up instead of consuming the peer's counter and
             # losing the message.  Bounded: a claimed view that never
-            # materializes drops out after the view-change timeout.
-            try:
-                await asyncio.wait_for(
-                    self.view_state.wait_current_at_least(msg_view),
-                    max(self._viewchange_timeout, 1.0) * 2,
-                )
-            except asyncio.TimeoutError:
-                # The claimed view never materialized: fall through to the
-                # normal capture-then-refuse path rather than returning
-                # here — dropping WITHOUT capturing would leave a counter
-                # gap that wedges every later message from this peer.
-                self.metrics.inc("messages_dropped_future_view")
+            # materializes drops out after the view-change timeout —
+            # EXCEPT while a state transfer is pending, which will
+            # advance the view (or keep retrying claimants): letting the
+            # park expire mid-transfer would capture-and-refuse commits
+            # for batches just above the incoming checkpoint, and the
+            # acceptor would then see an uncovered per-peer CV gap for
+            # the rest of the view.
+            while True:
+                try:
+                    await asyncio.wait_for(
+                        self.view_state.wait_current_at_least(msg_view),
+                        max(self._viewchange_timeout, 1.0) * 2,
+                    )
+                    break
+                except asyncio.TimeoutError:
+                    if self._snapshot_expect is not None:
+                        continue  # transfer in flight: keep parking
+                    # The claimed view never materialized: fall through
+                    # to the normal capture-then-refuse path rather than
+                    # returning here — dropping WITHOUT capturing would
+                    # leave a counter gap that wedges every later
+                    # message from this peer.
+                    self.metrics.inc("messages_dropped_future_view")
+                    break
 
         # Process embedded messages first (reference processEmbedded,
         # core/message-handling.go:454-473).  A batched PREPARE embeds up
@@ -768,21 +780,19 @@ class Handlers:
         if coll.stable_count != before:
             self._note_stable_locally()
 
-    # Upper bound on the stub coverage wait: honest stubs resolve as soon
-    # as the LOG-BASE earlier on the same stream is adopted (sub-ms);
-    # capping low bounds how long a Byzantine flood of uncovered stubs
-    # can pin bounded-concurrency slots.
-    _STUB_WAIT_CAP_S = 2.0
-
     async def _wait_covered(self, view: int, cv: int) -> bool:
         """True once the local stable checkpoint covers batch (view, cv);
         bounded wait — the honest case resolves as soon as the sender's
-        LOG-BASE certificate (earlier on the same stream) is adopted.
-        Honors a shorter configured view-change timeout (0 = no wait)."""
+        LOG-BASE certificate (earlier on the same stream) is adopted, but
+        certificate adoption can itself be slow (a cold verification
+        engine's first kernel compile takes tens of seconds), so the
+        bound matches the future-view park (2x the view-change timeout)
+        rather than being aggressively short — a refused honest stub
+        wedges its sender's whole capture stream.  Byzantine uncovered
+        stubs pin at most the bounded per-stream concurrency slots for
+        this long.  Honors a 0 view-change timeout (no wait, tests)."""
         loop = asyncio.get_running_loop()
-        deadline = loop.time() + min(
-            max(self._viewchange_timeout, 0.0), self._STUB_WAIT_CAP_S
-        )
+        deadline = loop.time() + 2.0 * max(self._viewchange_timeout, 0.0)
         while True:
             coll = self.checkpoint_collector
             if (view, cv) <= (coll.stable_view, coll.stable_cv):
@@ -914,9 +924,9 @@ class Handlers:
         # in place.
         cert_pos = cert[0].count if cert else 0
         old_pos = old_cert[0].count if old_cert else -1
-        head_exists = bool(
-            self.message_log.snapshot()
-        ) and isinstance(self.message_log.snapshot()[0], LogBase)
+        # entries still mirrors the live log here (nothing was dropped on
+        # this path, and stubbing never swaps in a LogBase).
+        head_exists = bool(entries) and isinstance(entries[0], LogBase)
         if stubbed or (head_exists and cert_pos > old_pos):
             if head_exists:
                 self.message_log.replace(0, head)

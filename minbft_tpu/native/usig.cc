@@ -24,6 +24,68 @@ constexpr unsigned char kSealMagic[4] = {'U', 'S', 'G', '2'};
 /* v1 blobs carried a sealed epoch (magic || epoch_be8 || key); accepted
  * for key recovery, with the stored epoch ignored. */
 constexpr unsigned char kSealMagicV1[4] = {'U', 'S', 'G', '1'};
+/* v3: encrypted-at-rest (the sgx_seal_data confidentiality analogue,
+ * reference usig.c:107-116).  Layout:
+ *   magic(4) || salt(16) || iters_be4 || nonce(12) || ct || tag(16)
+ * with key = PBKDF2-HMAC-SHA256(secret, salt, iters, 32) and
+ * AES-256-GCM over the DER private key. */
+constexpr unsigned char kSealMagicV3[4] = {'U', 'S', 'G', '3'};
+constexpr size_t kSaltLen = 16;
+constexpr size_t kNonceLen = 12;
+constexpr size_t kTagLen = 16;
+constexpr uint32_t kKdfIters = 60000;
+constexpr size_t kV3Overhead = 4 + kSaltLen + 4 + kNonceLen + kTagLen;
+
+bool kdf_key(const uint8_t *secret, size_t secret_len,
+             const unsigned char *salt, uint32_t iters,
+             unsigned char out[32]) {
+  return PKCS5_PBKDF2_HMAC(reinterpret_cast<const char *>(secret),
+                           static_cast<int>(secret_len), salt,
+                           static_cast<int>(kSaltLen),
+                           static_cast<int>(iters), EVP_sha256(), 32,
+                           out) == 1;
+}
+
+/* AES-256-GCM one-shot encrypt: ct || tag appended at out. */
+bool gcm_encrypt(const unsigned char key[32], const unsigned char *nonce,
+                 const unsigned char *plain, int plain_len,
+                 unsigned char *ct_out, unsigned char *tag_out) {
+  EVP_CIPHER_CTX *ctx = EVP_CIPHER_CTX_new();
+  if (ctx == nullptr) return false;
+  int len = 0, ok = 0;
+  ok = EVP_EncryptInit_ex(ctx, EVP_aes_256_gcm(), nullptr, nullptr, nullptr) == 1 &&
+       EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_GCM_SET_IVLEN,
+                           static_cast<int>(kNonceLen), nullptr) == 1 &&
+       EVP_EncryptInit_ex(ctx, nullptr, nullptr, key, nonce) == 1 &&
+       EVP_EncryptUpdate(ctx, ct_out, &len, plain, plain_len) == 1 &&
+       len == plain_len &&
+       EVP_EncryptFinal_ex(ctx, ct_out + len, &len) == 1 &&
+       EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_GCM_GET_TAG,
+                           static_cast<int>(kTagLen), tag_out) == 1;
+  EVP_CIPHER_CTX_free(ctx);
+  return ok;
+}
+
+bool gcm_decrypt(const unsigned char key[32], const unsigned char *nonce,
+                 const unsigned char *ct, int ct_len,
+                 const unsigned char *tag, unsigned char *plain_out) {
+  EVP_CIPHER_CTX *ctx = EVP_CIPHER_CTX_new();
+  if (ctx == nullptr) return false;
+  int len = 0, ok = 0;
+  unsigned char tagbuf[kTagLen];
+  std::memcpy(tagbuf, tag, kTagLen);
+  ok = EVP_DecryptInit_ex(ctx, EVP_aes_256_gcm(), nullptr, nullptr, nullptr) == 1 &&
+       EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_GCM_SET_IVLEN,
+                           static_cast<int>(kNonceLen), nullptr) == 1 &&
+       EVP_DecryptInit_ex(ctx, nullptr, nullptr, key, nonce) == 1 &&
+       EVP_DecryptUpdate(ctx, plain_out, &len, ct, ct_len) == 1 &&
+       len == ct_len &&
+       EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_GCM_SET_TAG,
+                           static_cast<int>(kTagLen), tagbuf) == 1 &&
+       EVP_DecryptFinal_ex(ctx, plain_out + len, &len) == 1;
+  EVP_CIPHER_CTX_free(ctx);
+  return ok;
+}
 
 /* DER ECDSA-Sig-Value -> raw r||s (32+32 big-endian).  The encoding is
  * SEQUENCE { INTEGER r, INTEGER s } with minimal-length integers. */
@@ -122,6 +184,11 @@ extern "C" {
 const char *usig_native_version(void) { return "minbft-tpu-usig/1 openssl3"; }
 
 int usig_init(usig_t **out, const uint8_t *sealed, size_t sealed_len) {
+  return usig_init2(out, sealed, sealed_len, nullptr, 0);
+}
+
+int usig_init2(usig_t **out, const uint8_t *sealed, size_t sealed_len,
+               const uint8_t *secret, size_t secret_len) {
   if (out == nullptr) return USIG_ERR_ARG;
   usig_t *u = new (std::nothrow) usig_t;
   if (u == nullptr) return USIG_ERR_ALLOC;
@@ -143,6 +210,48 @@ int usig_init(usig_t **out, const uint8_t *sealed, size_t sealed_len) {
     if (u->key == nullptr) {
       delete u;
       return USIG_ERR_CRYPTO;
+    }
+  } else if (sealed_len > kV3Overhead &&
+             std::memcmp(sealed, kSealMagicV3, 4) == 0) {
+    /* v3: AES-256-GCM under the operator secret. */
+    if (secret == nullptr || secret_len == 0) {
+      delete u;
+      return USIG_ERR_SECRET;
+    }
+    const unsigned char *salt = sealed + 4;
+    uint32_t iters = 0;
+    for (int i = 0; i < 4; ++i)
+      iters = (iters << 8) | sealed[4 + kSaltLen + i];
+    if (iters == 0 || iters > 10u * 1000u * 1000u) {
+      delete u;
+      return USIG_ERR_SEALED;
+    }
+    const unsigned char *nonce = sealed + 4 + kSaltLen + 4;
+    const unsigned char *ct = nonce + kNonceLen;
+    size_t ct_len = sealed_len - kV3Overhead;
+    const unsigned char *tag = ct + ct_len;
+    unsigned char key[32];
+    std::vector<unsigned char> plain(ct_len);
+    if (!kdf_key(secret, secret_len, salt, iters, key)) {
+      delete u;
+      return USIG_ERR_CRYPTO;
+    }
+    if (!gcm_decrypt(key, nonce, ct, static_cast<int>(ct_len), tag,
+                     plain.data())) {
+      /* GCM wrote (garbage or partially correct) plaintext before the
+       * tag check failed — scrub it like the success path does. */
+      std::memset(plain.data(), 0, plain.size());
+      std::memset(key, 0, sizeof key);
+      delete u;
+      return USIG_ERR_SECRET;
+    }
+    std::memset(key, 0, sizeof key);
+    const unsigned char *p = plain.data();
+    u->key = d2i_AutoPrivateKey(nullptr, &p, static_cast<long>(ct_len));
+    std::memset(plain.data(), 0, plain.size());
+    if (u->key == nullptr) {
+      delete u;
+      return USIG_ERR_SEALED;
     }
   } else {
     size_t key_off;
@@ -235,6 +344,53 @@ int usig_seal(usig_t *u, uint8_t *out, size_t cap, size_t *out_len) {
   int der_len = i2d_PrivateKey(u->key, &p);
   if (der_len <= 0) return USIG_ERR_CRYPTO;
   *out_len = 4 + static_cast<size_t>(der_len);
+  return USIG_OK;
+}
+
+int usig_sealed_size2(usig_t *u, size_t secret_len, size_t *out) {
+  if (u == nullptr || out == nullptr) return USIG_ERR_ARG;
+  int der_len = i2d_PrivateKey(u->key, nullptr);
+  if (der_len <= 0) return USIG_ERR_CRYPTO;
+  *out = (secret_len == 0 ? 4 : kV3Overhead) + static_cast<size_t>(der_len);
+  return USIG_OK;
+}
+
+int usig_seal2(usig_t *u, const uint8_t *secret, size_t secret_len,
+               uint8_t *out, size_t cap, size_t *out_len) {
+  if (u == nullptr || out == nullptr || out_len == nullptr)
+    return USIG_ERR_ARG;
+  if (secret == nullptr || secret_len == 0)
+    return usig_seal(u, out, cap, out_len);
+  size_t need = 0;
+  int rc = usig_sealed_size2(u, secret_len, &need);
+  if (rc != USIG_OK) return rc;
+  if (cap < need) return USIG_ERR_BUFSZ;
+  int der_len = i2d_PrivateKey(u->key, nullptr);
+  if (der_len <= 0) return USIG_ERR_CRYPTO;
+  std::vector<unsigned char> der(static_cast<size_t>(der_len));
+  unsigned char *dp = der.data();
+  if (i2d_PrivateKey(u->key, &dp) != der_len) return USIG_ERR_CRYPTO;
+
+  std::memcpy(out, kSealMagicV3, 4);
+  unsigned char *salt = out + 4;
+  unsigned char *itp = out + 4 + kSaltLen;
+  unsigned char *nonce = itp + 4;
+  unsigned char *ct = nonce + kNonceLen;
+  unsigned char *tag = ct + der_len;
+  if (RAND_bytes(salt, static_cast<int>(kSaltLen)) != 1 ||
+      RAND_bytes(nonce, static_cast<int>(kNonceLen)) != 1) {
+    std::memset(der.data(), 0, der.size());
+    return USIG_ERR_CRYPTO;
+  }
+  for (int i = 0; i < 4; ++i)
+    itp[i] = static_cast<unsigned char>(kKdfIters >> (24 - 8 * i));
+  unsigned char key[32];
+  int ok = kdf_key(secret, secret_len, salt, kKdfIters, key) &&
+           gcm_encrypt(key, nonce, der.data(), der_len, ct, tag);
+  std::memset(key, 0, sizeof key);
+  std::memset(der.data(), 0, der.size());
+  if (!ok) return USIG_ERR_CRYPTO;
+  *out_len = kV3Overhead + static_cast<size_t>(der_len);
   return USIG_OK;
 }
 

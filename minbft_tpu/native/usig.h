@@ -14,9 +14,12 @@
  *    epoch drawn on every init — including restores (usig.c:168-186) — so
  *    a restarted instance whose counter restarts at 1 can never
  *    re-certify already-issued (epoch, cv) values.  Without SGX there is
- *    no hardware sealing root: the "sealed" blob is the serialized key
- *    (the same trust level as the reference running in SGX SIM mode,
- *    where sgx_seal_data is simulated in software).
+ *    no hardware sealing root; the v3 sealed format instead encrypts the
+ *    key with AES-256-GCM under an operator-supplied secret
+ *    (PBKDF2-HMAC-SHA256 KDF) so a stolen blob discloses nothing —
+ *    the confidentiality property of sgx_seal_data (usig.c:107-116)
+ *    under a software root of trust.  Sealing without a secret keeps
+ *    the v2 plaintext layout for compatibility.
  *
  * The byte formats match minbft_tpu/usig/software.py EcdsaUSIG exactly
  * (cert payload, epoch || x || y identity), so UIs created natively verify
@@ -42,6 +45,7 @@ enum {
   USIG_ERR_SEALED = 3, /* malformed sealed blob */
   USIG_ERR_ARG = 4,
   USIG_ERR_BUFSZ = 5,
+  USIG_ERR_SECRET = 6, /* encrypted blob: secret missing or wrong */
 };
 
 /* Create an instance.  sealed==NULL generates a fresh keypair; otherwise
@@ -69,6 +73,15 @@ int usig_get_pubkey(usig_t *u, uint8_t out[64]);
  * seal into a caller buffer. */
 int usig_sealed_size(usig_t *u, size_t *out);
 int usig_seal(usig_t *u, uint8_t *out, size_t cap, size_t *out_len);
+
+/* Encrypted sealing (v3, sgx_seal_data confidentiality analogue):
+ * secret==NULL/len==0 degrades to the plaintext v2 paths above.
+ * usig_init2 accepts v3 (requires the right secret), v2 and v1 blobs. */
+int usig_init2(usig_t **out, const uint8_t *sealed, size_t sealed_len,
+               const uint8_t *secret, size_t secret_len);
+int usig_sealed_size2(usig_t *u, size_t secret_len, size_t *out);
+int usig_seal2(usig_t *u, const uint8_t *secret, size_t secret_len,
+               uint8_t *out, size_t cap, size_t *out_len);
 
 /* Host-side UI verification (used by the C++ test and as a fast serial
  * fallback): pub is x||y (64B), sig is r||s (64B). Returns USIG_OK when

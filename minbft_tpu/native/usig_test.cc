@@ -106,6 +106,43 @@ int main() {
   size_t out_len = 0;
   CHECK(usig_seal(u, tiny, sizeof tiny, &out_len) == USIG_ERR_BUFSZ);
 
+  /* encrypted sealing (v3): round-trips under the right secret, is
+   * refused without one or with the wrong one, and the blob holds no
+   * plaintext DER (sgx_seal_data confidentiality analogue). */
+  {
+    const uint8_t secret[] = "operator-secret";
+    size_t need3 = 0;
+    CHECK(usig_sealed_size2(u, sizeof secret - 1, &need3) == USIG_OK);
+    std::vector<uint8_t> enc(need3);
+    size_t enc_len = 0;
+    CHECK(usig_seal2(u, secret, sizeof secret - 1, enc.data(), enc.size(),
+                     &enc_len) == USIG_OK);
+    CHECK(enc_len == need3);
+    /* the plaintext DER (from the v2 blob) must not appear in the
+     * ciphertext */
+    const uint8_t *der = blob.data() + 4;
+    size_t der_len = sealed_len - 4;
+    bool found = false;
+    for (size_t i = 0; i + der_len <= enc_len && !found; ++i)
+      found = std::memcmp(enc.data() + i, der, der_len) == 0;
+    CHECK(!found);
+
+    usig_t *u5 = nullptr;
+    CHECK(usig_init2(&u5, enc.data(), enc_len, secret, sizeof secret - 1) ==
+          USIG_OK);
+    uint8_t pub5[64];
+    CHECK(usig_get_pubkey(u5, pub5) == USIG_OK);
+    CHECK(std::memcmp(pub, pub5, 64) == 0);
+    CHECK(usig_destroy(u5) == USIG_OK);
+
+    usig_t *u6 = nullptr;
+    CHECK(usig_init2(&u6, enc.data(), enc_len, nullptr, 0) ==
+          USIG_ERR_SECRET);
+    const uint8_t wrong[] = "wrong-secret";
+    CHECK(usig_init2(&u6, enc.data(), enc_len, wrong, sizeof wrong - 1) ==
+          USIG_ERR_SECRET);
+  }
+
   CHECK(usig_destroy(u) == USIG_OK);
   CHECK(usig_destroy(u2) == USIG_OK);
 

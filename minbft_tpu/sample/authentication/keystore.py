@@ -179,7 +179,35 @@ class KeyStore:
 
     # -- serialization -------------------------------------------------------
 
-    def to_dict(self) -> dict:
+    def to_dict(self, secret: Optional[bytes] = None) -> dict:
+        """Serializable form.  With ``secret``, every PRIVATE field —
+        signature private keys, sealed USIG blobs, the pairwise MAC
+        matrix — is AES-256-GCM encrypted under a per-file master key
+        (one PBKDF2 derivation, random salt recorded in the ``seal``
+        section): a stolen keys.yaml then discloses no key material,
+        matching the reference's sgx_seal_data property
+        (reference usig/sgx/enclave/usig.c:107-116).  Public fields stay
+        plaintext (peers need them)."""
+        from ...utils import sealbox
+
+        seal_hdr = {}
+        if secret is not None:
+            salt = secrets.token_bytes(sealbox.SALT_LEN)
+            mk = sealbox.derive_key(secret, salt)
+            seal_hdr["seal"] = {
+                "kdf": sealbox.KDF,
+                "salt": base64.b64encode(salt).decode(),
+                "iterations": sealbox.ITERATIONS,
+            }
+
+            def enc(v: bytes) -> str:
+                return base64.b64encode(sealbox.box(v, mk)).decode()
+
+        else:
+
+            def enc(v: bytes) -> str:
+                return base64.b64encode(v).decode()
+
         def sig_section(keys):
             return {
                 "keyspec": _SPEC_FOR_SCHEME[self.scheme],
@@ -187,7 +215,7 @@ class KeyStore:
                     {
                         "id": kid,
                         **(
-                            {"privateKey": base64.b64encode(priv).decode()}
+                            {"privateKey": enc(priv)}
                             if priv is not None
                             else {}
                         ),
@@ -202,15 +230,16 @@ class KeyStore:
             mac_section["macs"] = {
                 "keyspec": "HMAC_PAIRWISE",
                 "clientReplica": [
-                    {"client": c, "replica": r, "key": base64.b64encode(k).decode()}
+                    {"client": c, "replica": r, "key": enc(k)}
                     for (c, r), k in sorted(self.mac_keys.client_replica.items())
                 ],
                 "replicaPair": [
-                    {"i": i, "j": j, "key": base64.b64encode(k).decode()}
+                    {"i": i, "j": j, "key": enc(k)}
                     for (i, j), k in sorted(self.mac_keys.replica_pair.items())
                 ],
             }
         return {
+            **seal_hdr,
             "replica": sig_section(self.replica_keys),
             "client": sig_section(self.client_keys),
             **mac_section,
@@ -220,7 +249,7 @@ class KeyStore:
                     {
                         "id": kid,
                         **(
-                            {"sealedKey": base64.b64encode(sealed).decode()}
+                            {"sealedKey": enc(sealed)}
                             if sealed is not None
                             else {}
                         ),
@@ -232,7 +261,35 @@ class KeyStore:
         }
 
     @classmethod
-    def from_dict(cls, data: dict) -> "KeyStore":
+    def from_dict(cls, data: dict, secret: Optional[bytes] = None) -> "KeyStore":
+        from ...utils import sealbox
+
+        seal = data.get("seal")
+        if seal is not None:
+            if secret is None:
+                raise KeyStoreError(
+                    "keystore is sealed: set MINBFT_SEAL_SECRET or "
+                    "MINBFT_SEAL_SECRET_FILE to open it"
+                )
+            if seal.get("kdf") != sealbox.KDF:
+                raise KeyStoreError(f"unknown seal kdf {seal.get('kdf')!r}")
+            mk = sealbox.derive_key(
+                secret,
+                base64.b64decode(seal["salt"]),
+                int(seal.get("iterations", sealbox.ITERATIONS)),
+            )
+
+            def dec(s: str) -> bytes:
+                try:
+                    return sealbox.unbox(base64.b64decode(s), mk)
+                except sealbox.SealError as e:
+                    raise KeyStoreError(str(e)) from e
+
+        else:
+
+            def dec(s: str) -> bytes:
+                return base64.b64decode(s)
+
         rep = data.get("replica", {})
         spec = rep.get("keyspec", "ECDSA_P256")
         if spec not in _SIG_SPECS:
@@ -252,7 +309,7 @@ class KeyStore:
             for entry in section.get("keys", []):
                 priv = entry.get("privateKey")
                 out[int(entry["id"])] = (
-                    base64.b64decode(priv) if priv else None,
+                    dec(priv) if priv else None,
                     base64.b64decode(entry["publicKey"]),
                 )
             return out
@@ -268,11 +325,11 @@ class KeyStore:
 
             store.mac_keys = MacKeys(
                 {
-                    (int(e["client"]), int(e["replica"])): base64.b64decode(e["key"])
+                    (int(e["client"]), int(e["replica"])): dec(e["key"])
                     for e in macs.get("clientReplica", [])
                 },
                 {
-                    (int(e["i"]), int(e["j"])): base64.b64decode(e["key"])
+                    (int(e["i"]), int(e["j"])): dec(e["key"])
                     for e in macs.get("replicaPair", [])
                 },
             )
@@ -285,35 +342,47 @@ class KeyStore:
                 # is volatile and must not be pinned — strip it.
                 anchor = base64.b64decode(entry["usigId"])[_EPOCH_LEN:]
             store.usig_keys[int(entry["id"])] = (
-                base64.b64decode(sealed) if sealed else None,
+                dec(sealed) if sealed else None,
                 anchor,
             )
         return store
 
-    def save(self, path: str) -> None:
-        """Write keys.yaml with owner-only permissions: the file holds
-        private signature keys, sealed USIG blobs, and (if present) the
-        pairwise MAC matrix.  Deployment flows should distribute
-        per-replica ``strip_private(keep_replica=i)`` copies, not this
-        full store."""
+    _SECRET_FROM_ENV = object()  # sentinel: source the seal secret lazily
+
+    def save(self, path: str, secret=_SECRET_FROM_ENV) -> None:
+        """Write keys.yaml with owner-only permissions.  When a sealing
+        secret is configured (MINBFT_SEAL_SECRET / _FILE, or passed
+        explicitly) every private field is encrypted at rest — see
+        :meth:`to_dict`; otherwise 0600 permissions are the only
+        protection (the round-3 behavior).  Deployment flows should
+        distribute per-replica ``strip_private(keep_replica=i)`` copies,
+        not this full store."""
         import os as _os
 
         import yaml
 
+        from ...utils import sealbox
+
+        if secret is KeyStore._SECRET_FROM_ENV:
+            secret = sealbox.seal_secret()
         fd = _os.open(path, _os.O_CREAT | _os.O_WRONLY | _os.O_TRUNC, 0o600)
         # O_CREAT's mode only applies to newly-created files; tighten a
         # pre-existing laxer file too before writing secrets into it.
         _os.fchmod(fd, 0o600)
         with _os.fdopen(fd, "w") as fh:
-            yaml.safe_dump(self.to_dict(), fh, sort_keys=False)
+            yaml.safe_dump(self.to_dict(secret=secret), fh, sort_keys=False)
 
     @classmethod
-    def load(cls, path: str) -> "KeyStore":
+    def load(cls, path: str, secret=_SECRET_FROM_ENV) -> "KeyStore":
         import yaml
 
+        from ...utils import sealbox
+
+        if secret is KeyStore._SECRET_FROM_ENV:
+            secret = sealbox.seal_secret()
         with open(path) as fh:
             data = yaml.safe_load(fh) or {}
-        return cls.from_dict(data)
+        return cls.from_dict(data, secret=secret)
 
     def strip_private(self, keep_replica: Optional[int] = None) -> "KeyStore":
         """A copy safe to hand to other nodes: private material removed

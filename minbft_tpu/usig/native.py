@@ -72,6 +72,24 @@ def load(auto_build: bool = False) -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
+    if not hasattr(lib, "usig_init2"):
+        # Stale build predating encrypted sealing (v3): rebuild + reload
+        # (the rebuilt file is a new inode, so dlopen yields a fresh
+        # handle).
+        if not build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        if not hasattr(lib, "usig_init2"):
+            return None
+    _bind(lib)
+    _lib = lib
+    return _lib
+
+
+def _bind(lib) -> None:
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.usig_init.argtypes = [
         ctypes.POINTER(ctypes.c_void_p),
@@ -108,8 +126,26 @@ def load(auto_build: bool = False) -> Optional[ctypes.CDLL]:
         ctypes.c_char_p,
     ]
     lib.usig_native_version.restype = ctypes.c_char_p
-    _lib = lib
-    return _lib
+    lib.usig_init2.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.usig_sealed_size2.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.usig_seal2.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        u8p,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
 
 
 def available(auto_build: bool = False) -> bool:
@@ -122,19 +158,30 @@ class NativeEcdsaUSIG(USIG):
 
     SCHEME = "ecdsa-p256"
 
-    def __init__(self, sealed: Optional[bytes] = None, _lib_override=None):
+    def __init__(
+        self,
+        sealed: Optional[bytes] = None,
+        secret: Optional[bytes] = None,
+        _lib_override=None,
+    ):
         lib = _lib_override or load(auto_build=True)
         if lib is None:
             raise UsigError("native USIG module not available (build failed?)")
         self._lib = lib
         handle = ctypes.c_void_p()
-        rc = lib.usig_init(
+        rc = lib.usig_init2(
             ctypes.byref(handle),
             sealed if sealed is not None else None,
             len(sealed) if sealed is not None else 0,
+            secret if secret else None,
+            len(secret) if secret else 0,
         )
         if rc != USIG_OK:
-            raise UsigError(f"usig_init failed (rc={rc})")
+            raise UsigError(
+                "usig_init failed: encrypted blob needs the sealing secret"
+                if rc == 6
+                else f"usig_init failed (rc={rc})"
+            )
         self._h = handle
         epoch = ctypes.c_uint64()
         if lib.usig_get_epoch(self._h, ctypes.byref(epoch)) != USIG_OK:
@@ -203,22 +250,41 @@ class NativeEcdsaUSIG(USIG):
 
     # -- sealing (durable state) --------------------------------------------
 
-    def seal(self) -> bytes:
+    def seal(self, secret: Optional[bytes] = None) -> bytes:
         """Export the sealed key blob (reference SealedKey,
         usig/sgx/usig-enclave.go:254-268).  The epoch is volatile by
-        design and is not part of the blob."""
+        design and is not part of the blob.  With ``secret`` the blob is
+        AES-256-GCM encrypted inside the native module (v3 — the
+        sgx_seal_data confidentiality analogue, reference
+        usig/sgx/enclave/usig.c:107-116); without, the plaintext v2
+        layout."""
         need = ctypes.c_size_t()
-        if self._lib.usig_sealed_size(self._h, ctypes.byref(need)) != USIG_OK:
+        if (
+            self._lib.usig_sealed_size2(
+                self._h, len(secret) if secret else 0, ctypes.byref(need)
+            )
+            != USIG_OK
+        ):
             raise UsigError("usig_sealed_size failed")
         buf = (ctypes.c_uint8 * need.value)()
         out_len = ctypes.c_size_t()
-        rc = self._lib.usig_seal(self._h, buf, need.value, ctypes.byref(out_len))
+        rc = self._lib.usig_seal2(
+            self._h,
+            secret if secret else None,
+            len(secret) if secret else 0,
+            buf,
+            need.value,
+            ctypes.byref(out_len),
+        )
         if rc != USIG_OK:
             raise UsigError(f"usig_seal failed (rc={rc})")
         return bytes(buf[: out_len.value])
 
     @classmethod
-    def from_sealed(cls, sealed: bytes) -> "NativeEcdsaUSIG":
+    def from_sealed(
+        cls, sealed: bytes, secret: Optional[bytes] = None
+    ) -> "NativeEcdsaUSIG":
         """Restore an instance: same key, FRESH epoch, counter restarts
-        at 1 (reference usig.c:168-186)."""
-        return cls(sealed=sealed)
+        at 1 (reference usig.c:168-186).  ``secret`` is required for v3
+        (encrypted) blobs."""
+        return cls(sealed=sealed, secret=secret)

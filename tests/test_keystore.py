@@ -198,3 +198,100 @@ def test_mac_section_roundtrip_and_cluster(tmp_path):
     assert stripped.mac_keys is not None
     assert all(k[1] == 2 for k in stripped.mac_keys.client_replica)
     assert all(2 in k for k in stripped.mac_keys.replica_pair)
+
+
+def test_sealed_keystore_encrypts_all_private_material(tmp_path):
+    """With an operator secret, keys.yaml holds no recoverable private
+    material: signature private keys, sealed USIG blobs, and MAC keys are
+    AES-256-GCM encrypted (the reference's sgx_seal_data property,
+    usig/sgx/enclave/usig.c:107-116); loading without the secret (or with
+    the wrong one) is refused."""
+    import base64
+
+    import yaml
+
+    from minbft_tpu.sample.authentication.keystore import (
+        KeyStore,
+        KeyStoreError,
+        generate_testnet_keys,
+    )
+
+    secret = b"correct horse battery staple"
+    store = generate_testnet_keys(3, n_clients=2, usig_spec="SOFT_ECDSA",
+                                  with_macs=True)
+    path = str(tmp_path / "keys.yaml")
+    store.save(path, secret=secret)
+
+    raw = open(path, "rb").read()
+    data = yaml.safe_load(raw)
+    assert "seal" in data and data["seal"]["kdf"] == "pbkdf2-sha256"
+    # no plaintext private scalar / sealed blob / MAC key appears in the file
+    for kid, (priv, _pub) in store.replica_keys.items():
+        assert base64.b64encode(priv) not in raw
+    for kid, (sealed, _a) in store.usig_keys.items():
+        assert base64.b64encode(sealed) not in raw
+    for _pair, k in store.mac_keys.replica_pair.items():
+        assert base64.b64encode(k) not in raw
+
+    back = KeyStore.load(path, secret=secret)
+    assert back.replica_keys == store.replica_keys
+    assert back.usig_keys == store.usig_keys
+    assert back.mac_keys.replica_pair == store.mac_keys.replica_pair
+    # a sealed store usable end to end: restore a USIG from it
+    assert back.make_usig(0) is not None
+
+    import pytest as _pytest
+
+    with _pytest.raises(KeyStoreError):
+        KeyStore.load(path, secret=None)
+    with _pytest.raises(KeyStoreError):
+        KeyStore.load(path, secret=b"wrong")
+
+
+def test_seal_secret_from_env(tmp_path, monkeypatch):
+    """save()/load() source the secret from MINBFT_SEAL_SECRET by default
+    — the deployment flow needs no code changes to turn sealing on."""
+    from minbft_tpu.sample.authentication.keystore import (
+        KeyStore,
+        KeyStoreError,
+        generate_testnet_keys,
+    )
+
+    monkeypatch.setenv("MINBFT_SEAL_SECRET", "env-secret")
+    store = generate_testnet_keys(3, n_clients=1, usig_spec="SOFT_ECDSA")
+    path = str(tmp_path / "keys.yaml")
+    store.save(path)
+
+    import yaml
+
+    assert "seal" in yaml.safe_load(open(path))
+    assert KeyStore.load(path).make_usig(1) is not None
+
+    monkeypatch.delenv("MINBFT_SEAL_SECRET")
+    import pytest as _pytest
+
+    with _pytest.raises(KeyStoreError):
+        KeyStore.load(path)
+
+
+def test_native_v3_encrypted_seal_roundtrip():
+    """The native module's v3 sealing: encrypted blob restores the same
+    key under the right secret and is refused otherwise."""
+    import pytest as _pytest
+
+    from minbft_tpu.usig import native
+
+    if not native.available(auto_build=True):
+        _pytest.skip("native USIG module unavailable")
+    u = native.NativeEcdsaUSIG()
+    blob = u.seal(secret=b"s3cret")
+    assert blob[:4] == b"USG3"
+    # plaintext layout differs: the v2 blob's DER must not appear
+    assert u.seal()[4:] not in blob
+    back = native.NativeEcdsaUSIG.from_sealed(blob, secret=b"s3cret")
+    assert back.public_key == u.public_key
+    assert back.epoch != u.epoch  # fresh epoch per init, as ever
+    with _pytest.raises(Exception):
+        native.NativeEcdsaUSIG.from_sealed(blob)
+    with _pytest.raises(Exception):
+        native.NativeEcdsaUSIG.from_sealed(blob, secret=b"nope")
