@@ -187,14 +187,31 @@ def _bench_cluster_repeated(*args, **kw) -> dict:
     prefix = kw.get("prefix", "e2e")
     out: dict = {}
     vals = []
-    for _ in range(max(runs, 1)):
-        out = asyncio.run(_bench_cluster(*args, **kw))
+    failed = 0
+    for i in range(max(runs, 1)):
+        try:
+            out = asyncio.run(_bench_cluster(*args, **kw))
+        except (asyncio.TimeoutError, TimeoutError):
+            # A wedged/stalled run (request past its timeout).  Record it
+            # and keep going: one bad run must not cost the WHOLE bench
+            # artifact (both round-4 full-bench attempts died this way in
+            # one config while every other config had numbers).
+            failed += 1
+            print(
+                json.dumps({f"{prefix}_run_{i}": "timeout"}),
+                file=sys.stderr,
+                flush=True,
+            )
+            continue
         vals.append(out[f"{prefix}_committed_req_per_sec"])
+    if failed:
+        out[f"{prefix}_failed_runs"] = failed
     out[f"{prefix}_req_per_sec_runs"] = vals
-    out[f"{prefix}_committed_req_per_sec"] = round(statistics.mean(vals), 1)
-    out[f"{prefix}_req_per_sec_stddev"] = (
-        round(statistics.stdev(vals), 1) if len(vals) > 1 else 0.0
-    )
+    if vals:
+        out[f"{prefix}_committed_req_per_sec"] = round(statistics.mean(vals), 1)
+        out[f"{prefix}_req_per_sec_stddev"] = (
+            round(statistics.stdev(vals), 1) if len(vals) > 1 else 0.0
+        )
     return out
 
 
@@ -282,8 +299,12 @@ async def _bench_cluster(
     configer = SimpleConfiger(
         n=n,
         f=f,
-        timeout_request=600.0,
-        timeout_prepare=300.0,
+        # Above the bench's own 240s per-request deadline: the bench
+        # measures steady state — a stalled run should fail fast at the
+        # bench timeout, not detonate a view-change cascade at 600s that
+        # turns one stall into a run-long livelock.
+        timeout_request=900.0,
+        timeout_prepare=450.0,
         batchsize_prepare=256,
     )
     # Signature-scheme placement, measured on the tunneled-TPU bench host
@@ -388,7 +409,7 @@ async def _bench_cluster(
 
     async def timed_request(client, k: int) -> None:
         t = time.time()
-        await asyncio.wait_for(client.request(b"op-%d" % k), timeout=600)
+        await asyncio.wait_for(client.request(b"op-%d" % k), timeout=240)
         latencies_ms.append((time.time() - t) * 1e3)
 
     async def drive(client) -> None:

@@ -144,7 +144,11 @@ def _bounds_dominate(new: Checkpoint, prev: Checkpoint) -> bool:
     prev attests, and strictly better somewhere (or attest new peers)."""
     prev_b = dict(prev.bounds)
     new_b = dict(new.bounds)
-    if any(new_b.get(p, 0) < b for p, b in prev_b.items()):
+    # An absent peer must never dominate a present bound — even a present
+    # 0 (bounds are attacker-chosen; absence == -1 keeps two claims that
+    # differ only in a 0-bound entry from alternately replacing each
+    # other and churning cert_version).
+    if any(new_b.get(p, -1) < b for p, b in prev_b.items()):
         return False
     return new_b != prev_b
 

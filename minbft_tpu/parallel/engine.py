@@ -66,6 +66,7 @@ class VerifyStats:
     padded_lanes: int = 0
     device_time_s: float = 0.0
     memo_hits: int = 0
+    dispatch_timeouts: int = 0  # hung device dispatches rescued on host
 
     @property
     def mean_batch(self) -> float:
@@ -85,6 +86,7 @@ class _SchemeQueue:
     """
 
     _MEMO_CAP = 16384
+    _WRITE_OFF_AFTER = 3  # CONSECUTIVE hung dispatches before host-only
 
     def __init__(self, engine: "BatchVerifier", name: str, dispatch):
         self.engine = engine
@@ -96,6 +98,8 @@ class _SchemeQueue:
         self.stats = VerifyStats()
         self._memo: "OrderedDict[object, bool]" = OrderedDict()
         self._inflight_futs: Dict[object, asyncio.Future] = {}
+        self._consecutive_timeouts = 0
+        self._device_written_off = False
 
     def submit(self, item) -> "asyncio.Future | _Resolved":
         verdict = self._memo.get(item)
@@ -145,7 +149,7 @@ class _SchemeQueue:
         items = [it for it, _ in batch]
         t0 = time.monotonic()
         try:
-            results = await asyncio.to_thread(self.dispatch, items)
+            results = await self._dispatch_with_fallback(items)
         except Exception as e:  # resolve all futures with the failure
             for it, _ in batch:
                 for fut in self._inflight_futs.pop(it, ()):
@@ -172,6 +176,51 @@ class _SchemeQueue:
         while len(memo) > self._MEMO_CAP:
             memo.popitem(last=False)
 
+    async def _dispatch_with_fallback(self, items):
+        """Run the dispatcher with a liveness net: on remote-attached
+        chips the tunnel occasionally stalls indefinitely mid-dispatch,
+        and a hung kernel call would wedge the whole verification queue —
+        every protocol task awaiting a verdict, forever.  Verification is
+        a pure function, so after ``dispatch_timeout`` the same items are
+        re-verified on the HOST (serial OpenSSL — slow but certain) and
+        the hung thread is abandoned; repeated timeouts write the device
+        off for this queue entirely (every later batch goes straight to
+        host) rather than paying the timeout again and again."""
+        fallback = self.engine._host_fallback_for(self.name)
+        timeout = self.engine.dispatch_timeout
+        if fallback is None or timeout <= 0:
+            return await asyncio.to_thread(self.dispatch, items)
+        if self._device_written_off:
+            return await asyncio.to_thread(fallback, items)
+        task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
+        try:
+            results = await asyncio.wait_for(asyncio.shield(task), timeout)
+            self._consecutive_timeouts = 0  # the device is healthy again
+            return results
+        except asyncio.TimeoutError:
+            # Abandon the hung thread; swallow whatever it eventually
+            # raises (an abandoned-task exception would otherwise spam
+            # "Task exception was never retrieved").
+            task.add_done_callback(
+                lambda t: t.exception() if not t.cancelled() else None
+            )
+            self.stats.dispatch_timeouts += 1
+            self._consecutive_timeouts += 1
+            if self._consecutive_timeouts >= self._WRITE_OFF_AFTER:
+                self._device_written_off = True
+            import logging
+
+            logging.getLogger("minbft.engine").error(
+                "%s device dispatch hung >%ss (%d consecutive%s): "
+                "verifying %d items on host",
+                self.name,
+                timeout,
+                self._consecutive_timeouts,
+                "; device written off" if self._device_written_off else "",
+                len(items),
+            )
+            return await asyncio.to_thread(fallback, items)
+
 
 class BatchVerifier:
     """The TPU-backed batch verification engine.
@@ -194,7 +243,13 @@ class BatchVerifier:
         buckets: Optional[Sequence[int]] = None,
         max_inflight: int = 2,
         mesh=None,
+        dispatch_timeout: float = 90.0,
     ):
+        # Liveness net for remote-attached chips: a device dispatch that
+        # exceeds this many seconds (generous — cold bucket compiles take
+        # ~40s) is abandoned and its items re-verified on host; see
+        # _SchemeQueue._dispatch_with_fallback.  0 disables.
+        self.dispatch_timeout = dispatch_timeout
         # Multi-chip: pass a jax.sharding.Mesh (parallel.mesh.make_mesh)
         # and every device dispatch routes through the sharded kernels —
         # the batch axis is partitioned over the mesh and XLA lays the
@@ -258,6 +313,15 @@ class BatchVerifier:
             q = _SchemeQueue(self, name, dispatch)
             self._queues[name] = q
         return q
+
+    def _host_fallback_for(self, name: str):
+        """Serial host re-verification for a DEVICE queue's items (None
+        for the host queues themselves — they cannot hang on a tunnel)."""
+        return {
+            "ecdsa_p256": self._dispatch_ecdsa_host,
+            "hmac_sha256": self._dispatch_hmac_host,
+            "ed25519": self._dispatch_ed25519_host,
+        }.get(name)
 
     @property
     def stats(self) -> Dict[str, VerifyStats]:

@@ -190,7 +190,18 @@ class KeyStore:
         plaintext (peers need them)."""
         from ...utils import sealbox
 
+        has_private = (
+            any(priv is not None for priv, _ in self.replica_keys.values())
+            or any(priv is not None for priv, _ in self.client_keys.values())
+            or any(sealed is not None for sealed, _ in self.usig_keys.values())
+            or self.mac_keys is not None
+        )
         seal_hdr = {}
+        if secret is not None and not has_private:
+            # A strip_private() copy holds only public material: emitting
+            # a seal header would make a fully-public file unreadable to
+            # consumers without the operator secret for no benefit.
+            secret = None
         if secret is not None:
             salt = secrets.token_bytes(sealbox.SALT_LEN)
             mk = sealbox.derive_key(secret, salt)
@@ -273,10 +284,13 @@ class KeyStore:
                 )
             if seal.get("kdf") != sealbox.KDF:
                 raise KeyStoreError(f"unknown seal kdf {seal.get('kdf')!r}")
+            iters = int(seal.get("iterations", sealbox.ITERATIONS))
+            if not 0 < iters <= 10_000_000:
+                # Mirror the native v3 parser's bound: a tampered file
+                # must not be able to spin PBKDF2 for hours.
+                raise KeyStoreError(f"seal iteration count {iters} out of range")
             mk = sealbox.derive_key(
-                secret,
-                base64.b64decode(seal["salt"]),
-                int(seal.get("iterations", sealbox.ITERATIONS)),
+                secret, base64.b64decode(seal["salt"]), iters
             )
 
             def dec(s: str) -> bytes:

@@ -72,18 +72,17 @@ def load(auto_build: bool = False) -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
-    if not hasattr(lib, "usig_init2"):
-        # Stale build predating encrypted sealing (v3): rebuild + reload
-        # (the rebuilt file is a new inode, so dlopen yields a fresh
-        # handle).
-        if not build():
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        if not hasattr(lib, "usig_init2"):
-            return None
+    if not hasattr(lib, "usig_init2") and auto_build:
+        # Stale build predating encrypted sealing (v3): rebuild + reload.
+        # The Makefile links to a temp name and renames, so the rebuilt
+        # file is a fresh inode and dlopen yields a new handle.
+        if build():
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                return None
+    # A stale-but-functional pre-v3 library (no compiler to rebuild with)
+    # still serves everything except encrypted sealing — bind what exists.
     _bind(lib)
     _lib = lib
     return _lib
@@ -126,26 +125,27 @@ def _bind(lib) -> None:
         ctypes.c_char_p,
     ]
     lib.usig_native_version.restype = ctypes.c_char_p
-    lib.usig_init2.argtypes = [
-        ctypes.POINTER(ctypes.c_void_p),
-        ctypes.c_char_p,
-        ctypes.c_size_t,
-        ctypes.c_char_p,
-        ctypes.c_size_t,
-    ]
-    lib.usig_sealed_size2.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_size_t,
-        ctypes.POINTER(ctypes.c_size_t),
-    ]
-    lib.usig_seal2.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_char_p,
-        ctypes.c_size_t,
-        u8p,
-        ctypes.c_size_t,
-        ctypes.POINTER(ctypes.c_size_t),
-    ]
+    if hasattr(lib, "usig_init2"):
+        lib.usig_init2.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.usig_sealed_size2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.usig_seal2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            u8p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
 
 
 def available(auto_build: bool = False) -> bool:
@@ -169,13 +169,25 @@ class NativeEcdsaUSIG(USIG):
             raise UsigError("native USIG module not available (build failed?)")
         self._lib = lib
         handle = ctypes.c_void_p()
-        rc = lib.usig_init2(
-            ctypes.byref(handle),
-            sealed if sealed is not None else None,
-            len(sealed) if sealed is not None else 0,
-            secret if secret else None,
-            len(secret) if secret else 0,
-        )
+        if hasattr(lib, "usig_init2"):
+            rc = lib.usig_init2(
+                ctypes.byref(handle),
+                sealed if sealed is not None else None,
+                len(sealed) if sealed is not None else 0,
+                secret if secret else None,
+                len(secret) if secret else 0,
+            )
+        elif secret or (sealed is not None and sealed[:4] == b"USG3"):
+            raise UsigError(
+                "this libusig.so predates encrypted sealing (v3); rebuild "
+                "the native module to use a sealing secret"
+            )
+        else:
+            rc = lib.usig_init(
+                ctypes.byref(handle),
+                sealed if sealed is not None else None,
+                len(sealed) if sealed is not None else 0,
+            )
         if rc != USIG_OK:
             raise UsigError(
                 "usig_init failed: encrypted blob needs the sealing secret"
@@ -258,6 +270,23 @@ class NativeEcdsaUSIG(USIG):
         sgx_seal_data confidentiality analogue, reference
         usig/sgx/enclave/usig.c:107-116); without, the plaintext v2
         layout."""
+        if not hasattr(self._lib, "usig_seal2"):
+            if secret:
+                raise UsigError(
+                    "this libusig.so predates encrypted sealing (v3); "
+                    "rebuild the native module to use a sealing secret"
+                )
+            need = ctypes.c_size_t()
+            if self._lib.usig_sealed_size(self._h, ctypes.byref(need)) != USIG_OK:
+                raise UsigError("usig_sealed_size failed")
+            buf = (ctypes.c_uint8 * need.value)()
+            out_len = ctypes.c_size_t()
+            rc = self._lib.usig_seal(
+                self._h, buf, need.value, ctypes.byref(out_len)
+            )
+            if rc != USIG_OK:
+                raise UsigError(f"usig_seal failed (rc={rc})")
+            return bytes(buf[: out_len.value])
         need = ctypes.c_size_t()
         if (
             self._lib.usig_sealed_size2(

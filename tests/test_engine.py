@@ -120,3 +120,50 @@ def test_cluster_with_batching_engine():
         assert total > 0
 
     asyncio.run(run())
+
+
+def test_hung_device_dispatch_falls_back_to_host():
+    """A device dispatch that hangs (tunnel stall — observed live) must
+    not wedge the verification queue: after dispatch_timeout the items
+    are re-verified on host, and repeated hangs write the device off so
+    later batches skip the wait entirely."""
+    import asyncio
+    import threading
+
+    from minbft_tpu.parallel.engine import BatchVerifier
+
+    async def scenario():
+        engine = BatchVerifier(max_batch=8, dispatch_timeout=0.2)
+        hang = threading.Event()
+
+        def hanging_dispatch(items):
+            hang.wait(30)  # simulates a stalled tunnel RPC
+            raise AssertionError("unreachable in test")
+
+        import numpy as np
+
+        def host_fallback(items):
+            return np.array([item == b"good" for item in items], dtype=bool)
+
+        engine._host_fallback_for = lambda name: host_fallback
+        q = engine._queue("ecdsa_p256", hanging_dispatch)
+
+        good = asyncio.ensure_future(q.submit(b"good"))
+        bad = asyncio.ensure_future(q.submit(b"bad"))
+        ok, nok = await asyncio.wait_for(asyncio.gather(good, bad), 10)
+        assert ok is True and nok is False
+        assert q.stats.dispatch_timeouts == 1
+
+        # two more hangs -> the device is written off; a later batch goes
+        # straight to host (no 0.2s wait — assert by elapsed time)
+        for _ in range(2):
+            await asyncio.wait_for(q.submit(b"good-%d" % _), 10)
+        assert q._device_written_off
+        t0 = asyncio.get_running_loop().time()
+        assert await asyncio.wait_for(q.submit(b"good"), 10) is True
+        # memo hit or host path; either way well under the device timeout
+        assert asyncio.get_running_loop().time() - t0 < 0.15
+        hang.set()  # let the abandoned threads exit
+        return True
+
+    assert asyncio.run(scenario())
