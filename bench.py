@@ -181,6 +181,7 @@ def _bench_cluster_repeated(*args, **kw) -> dict:
     tunneled host swing up to ±30%, so a judge (or an operator) needs the
     spread to tell progress from noise.  Non-throughput extras come from
     the last run."""
+    import faulthandler
     import statistics
 
     runs = int(os.environ.get("MINBFT_BENCH_RUNS", "3"))
@@ -189,6 +190,12 @@ def _bench_cluster_repeated(*args, **kw) -> dict:
     vals = []
     failed = 0
     for i in range(max(runs, 1)):
+        # Wedge forensics, armed while the run is LIVE: dumping from the
+        # except block would be too late — asyncio.run's teardown joins
+        # the (possibly hung) executor threads first and cancels every
+        # task stack.  A slow-but-honest cold run tripping this is just
+        # harmless stderr noise (exit=False).
+        faulthandler.dump_traceback_later(300, exit=False, file=sys.stderr)
         try:
             out = asyncio.run(_bench_cluster(*args, **kw))
         except (asyncio.TimeoutError, TimeoutError):
@@ -203,6 +210,8 @@ def _bench_cluster_repeated(*args, **kw) -> dict:
                 flush=True,
             )
             continue
+        finally:
+            faulthandler.cancel_dump_traceback_later()
         vals.append(out[f"{prefix}_committed_req_per_sec"])
     if failed:
         out[f"{prefix}_failed_runs"] = failed
