@@ -156,6 +156,39 @@ def bench_ed25519(batch: int, mode: str = "block") -> dict:
     }
 
 
+def bench_ed25519_sign(batch: int, mode: str = "block") -> dict:
+    """Batched Ed25519 signing: device r*B comb, host SHA-512 scalars +
+    batch-inverted compression (ops/ed25519.py sign_batch).  Mode follows
+    the harness like the other phases — the production path runs the
+    backend default, so that's what gets measured."""
+    import secrets
+
+    from minbft_tpu.ops import ed25519 as ed
+    from minbft_tpu.ops import lowering
+    from minbft_tpu.utils import hostcrypto as hc
+
+    lowering.set_mode(mode)
+    try:
+        seed, _ = hc.ed25519_keygen(secrets.token_bytes(32))
+        items = [(seed, b"ed-sign-bench")] * batch
+        t0 = time.time()
+        sigs = ed.sign_batch(items)
+        compile_s = time.time() - t0
+        assert sigs[0] == hc.ed25519_sign(seed, b"ed-sign-bench")
+        n_iter = 3
+        t0 = time.time()
+        for _ in range(n_iter):
+            ed.sign_batch(items)
+        dt = (time.time() - t0) / n_iter
+    finally:
+        lowering.set_mode(None)
+    return {
+        "ed25519_sign_batch": batch,
+        "ed25519_signs_per_sec": batch / dt,
+        "ed25519_sign_compile_s": round(compile_s, 1),
+    }
+
+
 def bench_hmac(batch: int = 8192) -> dict:
     from minbft_tpu.ops.hmac_sha256 import hmac_sign_kernel, hmac_verify_kernel
 
@@ -534,6 +567,7 @@ def main() -> None:
             extras["ecdsa_sign_big_per_sec"] = big["ecdsa_signs_per_sec"]
     if not os.environ.get("MINBFT_BENCH_SKIP_ED25519"):
         extras.update(bench_ed25519(batch, mode=mode))
+        extras.update(bench_ed25519_sign(min(batch, 8192), mode=mode))
     if not os.environ.get("MINBFT_BENCH_SKIP_E2E"):
         # BASELINE.md config 3 (the north star): n=7/f=3, 10k requests,
         # ECDSA-P256, COMMIT-phase verification batched on the chip.

@@ -306,3 +306,172 @@ def verify_batch_padded(
 
 def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     return verify_batch_padded(items, len(items))[: len(items)]
+
+
+# ---------------------------------------------------------------------------
+# Batched signing: the expensive half of RFC 8032 signing is the fixed-base
+# scalar multiplication r*B — the same comb that carried ECDSA signing
+# (ops/p256.py, see the note there), and simpler here because the Edwards
+# addition is COMPLETE: the v = 0 table rows are literally the identity
+# point and flow through _add with no flags or exceptional cases at all.
+# Host does the SHA-512 scalar derivations and the final compression
+# (one Montgomery batch inversion for the whole batch).
+
+_COMB_WINDOWS = 64
+_COMB_TABLE_NP: np.ndarray | None = None
+
+
+def _comb_table_np() -> np.ndarray:
+    """[64, 16, 3, NLIMBS] u32: (x, y, t=xy) affine Montgomery rows of
+    v * 16^j * B; v = 0 rows are the identity (0, 1, 0)."""
+    global _COMB_TABLE_NP
+    if _COMB_TABLE_NP is not None:
+        return _COMB_TABLE_NP
+    tab = np.zeros((_COMB_WINDOWS, 16, 3, limbs.NLIMBS), np.uint32)
+    one_m = to_limbs((1 << 256) % P)
+    for j in range(_COMB_WINDOWS):
+        tab[j, 0, 1] = one_m  # identity: (0 : 1 : 1 : 0)
+    base = hc.ED_BASE  # extended affine-ish host tuple (x, y, z=1, t)
+    for j in range(_COMB_WINDOWS):
+        acc = None
+        for v in range(1, 16):
+            acc = base if acc is None else hc.ed_add(acc, base)
+            x, y, z, _t = acc
+            zi = pow(z, -1, P)
+            xa, ya = x * zi % P, y * zi % P
+            tab[j, v, 0] = to_limbs((xa << 256) % P)
+            tab[j, v, 1] = to_limbs((ya << 256) % P)
+            tab[j, v, 2] = to_limbs((xa * ya % P << 256) % P)
+        base = hc.ed_scalar_mult(16, base)
+    _COMB_TABLE_NP = tab
+    return tab
+
+
+def _rb_comb_one(r: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """Scalar-shaped r*B via the fixed-base comb -> [3, NLIMBS] u16
+    (X, Y, Z extended coords, Montgomery domain; narrow transfer)."""
+    one = mont_one(FIELD)
+    shifts = (4 * jnp.arange(4, dtype=jnp.uint32))[None, :]
+    nibs = ((r[:, None] >> shifts) & 0xF).reshape(_COMB_WINDOWS)
+
+    def body(j, acc):
+        tab_j = lax.dynamic_index_in_dim(table, j, keepdims=False)  # [16,3,L]
+        v = lax.dynamic_index_in_dim(nibs, j, keepdims=False)
+        mask = (jnp.arange(16, dtype=jnp.uint32) == v)[:, None, None]
+        sel = jnp.sum(jnp.where(mask, tab_j, 0), axis=0)  # [3, L]
+        q = EdPoint(
+            fe_from_array(sel[0]), fe_from_array(sel[1]), one,
+            fe_from_array(sel[2]),
+        )
+        return _add(acc, q)
+
+    res = lax.fori_loop(0, _COMB_WINDOWS, body, _identity())
+    out = jnp.stack(
+        [
+            limbs.fe_to_array(res.x),
+            limbs.fe_to_array(res.y),
+            limbs.fe_to_array(res.z),
+        ]
+    )
+    return out.astype(jnp.uint16)
+
+
+_rb_comb_batch = None
+
+
+def ed25519_rb_kernel(r_arr) -> jnp.ndarray:
+    """Batched r*B — [B, 16] limb rows in (uploaded u16), [B, 3, 16] u16
+    out.  Table closed over as a jit constant (never a per-call upload)."""
+    global _rb_comb_batch
+    if _rb_comb_batch is None:
+        table = jnp.asarray(_comb_table_np())
+
+        def widen(r16):
+            return jax.vmap(_rb_comb_one, in_axes=(0, None))(
+                r16.astype(jnp.uint32), table
+            )
+
+        from .lowering import per_mode_jit as _pmj
+
+        _rb_comb_batch = _pmj(widen)
+    return _rb_comb_batch(jnp.asarray(np.asarray(r_arr).astype(np.uint16)))
+
+
+_batch_inv = limbs.batch_inv_host
+
+
+def sign_batch(
+    items: Sequence[Tuple[bytes, bytes]],
+    bucket: int = 0,
+    chunk: int = 4096,
+) -> list:
+    """[(seed32, msg)] -> [signature64] — RFC 8032 deterministic,
+    byte-identical to :func:`minbft_tpu.utils.hostcrypto.ed25519_sign`.
+    Device computes r*B (the comb); host derives the scalars (SHA-512),
+    batch-inverts the Zs for compression, and finishes s = r + k*a.
+
+    Shape discipline matches :func:`minbft_tpu.ops.p256.sign_batch`:
+    ``bucket`` pads to a fixed size, and anything larger is padded up to a
+    multiple of ``chunk`` (pad lanes compute 1*B and are discarded) so
+    varying batch sizes share compiled kernels — a fresh shape costs a
+    ~15s compile — while chunked launches pipeline the transfers."""
+    import hashlib
+
+    b = len(items)
+    if b == 0 and bucket == 0:
+        return []
+    total = max(bucket, b)
+    if total > chunk:
+        total = -(-total // chunk) * chunk
+    pad = total - b
+    pubs: dict = {}
+    rs = []
+    meta = []
+    r_arr = np.zeros((total, limbs.NLIMBS), np.uint32)
+    for i, (seed, msg) in enumerate(items):
+        h = hashlib.sha512(seed).digest()
+        a = int.from_bytes(h[:32], "little")
+        a = (a & ((1 << 254) - 8)) | (1 << 254)
+        pub = pubs.get(seed)
+        if pub is None:
+            pub = hc.ed25519_keygen(seed)[1]
+            pubs[seed] = pub
+        r = (
+            int.from_bytes(hashlib.sha512(h[32:] + msg).digest(), "little")
+            % L
+        )
+        rs.append(r)
+        meta.append((a, pub, msg))
+        r_arr[i] = to_limbs(r)
+    if pad:
+        r_arr[b:, 0] = 1  # r = 1: a valid lane, result discarded
+
+    step = chunk if total > chunk else total
+    outs = [
+        ed25519_rb_kernel(r_arr[c0 : c0 + step])
+        for c0 in range(0, total, step)
+    ]
+    xyz = np.concatenate([np.asarray(o) for o in outs])[:b]  # [B,3,16] u16
+
+    rinv = pow(1 << 256, -1, P)  # undo the Montgomery factor
+    ints = [
+        [
+            int.from_bytes(row.astype("<u2").tobytes(), "little") * rinv % P
+            for row in lane
+        ]
+        for lane in xyz
+    ]
+    z_invs = _batch_inv([lane[2] for lane in ints], P)
+    out = []
+    for i, (a, pub, msg) in enumerate(meta):
+        x, y, _z = ints[i]
+        zi = z_invs[i]
+        xa, ya = x * zi % P, y * zi % P
+        rp = (ya | ((xa & 1) << 255)).to_bytes(32, "little")
+        k = (
+            int.from_bytes(hashlib.sha512(rp + pub + msg).digest(), "little")
+            % L
+        )
+        s = (rs[i] + k * a) % L
+        out.append(rp + s.to_bytes(32, "little"))
+    return out

@@ -383,3 +383,21 @@ def mont_inv(spec: FieldSpec, a: Fe) -> Fe:
     return mont_pow_static(spec, a, spec.modulus_int - 2)
 
 
+
+
+def batch_inv_host(vals, mod):
+    """Host-side Montgomery batch inversion: one ``pow`` + 3(B-1) mults
+    for B inverses (a host pow costs ~25us; a mult ~0.1us).  All vals
+    must be nonzero.  Shared by the P-256 and Ed25519 sign paths."""
+    n = len(vals)
+    if n == 0:
+        return []
+    prefix = [1] * (n + 1)
+    for i, v in enumerate(vals):
+        prefix[i + 1] = prefix[i] * v % mod
+    inv_total = pow(prefix[n], -1, mod)
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        out[i] = prefix[i] * inv_total % mod
+        inv_total = inv_total * vals[i] % mod
+    return out

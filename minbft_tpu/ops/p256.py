@@ -510,21 +510,7 @@ def ecdsa_kg_kernel(k_arr) -> jnp.ndarray:
     return _kg_comb_batch(jnp.asarray(np.asarray(k_arr).astype(np.uint16)))
 
 
-def _batch_inv(vals: list, mod: int) -> list:
-    """Montgomery batch inversion: one ``pow`` + 3(B-1) mults for B
-    inverses (a host pow costs ~25us; a mult ~0.1us).  All vals nonzero."""
-    n = len(vals)
-    if n == 0:
-        return []
-    prefix = [1] * (n + 1)
-    for i, v in enumerate(vals):
-        prefix[i + 1] = prefix[i] * v % mod
-    inv_total = pow(prefix[n], -1, mod)
-    out = [0] * n
-    for i in range(n - 1, -1, -1):
-        out[i] = prefix[i] * inv_total % mod
-        inv_total = inv_total * vals[i] % mod
-    return out
+_batch_inv = limbs.batch_inv_host
 
 
 def sign_batch(

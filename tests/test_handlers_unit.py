@@ -393,3 +393,36 @@ def test_live_stub_for_uncovered_batch_refused_without_capture():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_log_base_validation_rejects_unprovable_base():
+    """A LOG-BASE announcement is exactly its certificate: f+1 matching
+    signed checkpoints each attesting a coverage bound for the sender at
+    or above the announced base.  A Byzantine peer announcing a base its
+    certificate cannot prove (hiding live history from its replayed log)
+    is refused."""
+
+    async def scenario():
+        from minbft_tpu.messages import Checkpoint, LogBase
+
+        h = _handlers(replica_id=2)
+
+        def cp(replica, bound):
+            return Checkpoint(
+                replica_id=replica, count=100, view=0, cv=50,
+                digest=b"D" * 32, bounds=((1, bound),), signature=b"s",
+            )
+
+        good = LogBase(replica_id=1, base=10, cert=(cp(0, 10), cp(3, 12)))
+        await h.validate_message(good)  # bounds 10,12 >= base 10: ok
+
+        over = LogBase(replica_id=1, base=20, cert=(cp(0, 10), cp(3, 12)))
+        with pytest.raises(api.AuthenticationError, match="coverage bounds"):
+            await h.validate_message(over)
+
+        short = LogBase(replica_id=1, base=5, cert=(cp(0, 10),))
+        with pytest.raises(api.AuthenticationError, match="f\\+1"):
+            await h.validate_message(short)
+        return True
+
+    assert asyncio.run(scenario())

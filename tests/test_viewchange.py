@@ -320,3 +320,145 @@ def test_view_change_escalates_past_faulty_new_primary():
         return True
 
     assert asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-truncated VIEW-CHANGE validation (phase 2): a Byzantine sender
+# must not be able to hide evidence behind an unprovable truncation base or
+# an uncovered stub — the coverage-bound audit is what keeps GC safe at
+# n = 2f+1 where quorum intersections can be entirely Byzantine.
+
+
+def _cp_claim(replica, bounds, count=100, view=0, cv=50, digest=b"D" * 32):
+    from minbft_tpu.messages import Checkpoint
+
+    return Checkpoint(
+        replica_id=replica, count=count, view=view, cv=cv, digest=digest,
+        bounds=tuple(sorted(bounds.items())), signature=b"sig",
+    )
+
+
+def _truncating_validator(f=1):
+    from minbft_tpu.core import checkpoint as cp_mod
+
+    async def verify_signature(msg):
+        return None
+
+    cert_validator = cp_mod.make_cert_validator(f, verify_signature)
+    return vc_mod.make_view_change_validator(_UIOnlyVerifier(), cert_validator)
+
+
+def test_truncated_vc_requires_provable_base():
+    validate = _truncating_validator()
+    entry = _prepare(11, primary=1)
+    entry.ui.counter = 11  # retained suffix starts above the base
+
+    # base 10 without any certificate: rejected
+    bare = ViewChange(
+        replica_id=1, new_view=1, log=(entry,), ui=UI(counter=12),
+        log_base=10,
+    )
+    with pytest.raises(api.AuthenticationError, match="certificate"):
+        asyncio.run(validate(bare))
+
+    # certificate whose coverage bounds for the sender stop short of the
+    # base: the dropped prefix is NOT provably covered -> rejected
+    weak_cert = (
+        _cp_claim(2, {1: 4}),
+        _cp_claim(3, {1: 10}),
+    )
+    weak = ViewChange(
+        replica_id=1, new_view=1, log=(entry,), ui=UI(counter=12),
+        log_base=10, checkpoint_cert=weak_cert,
+    )
+    with pytest.raises(api.AuthenticationError, match="not provably covered"):
+        asyncio.run(validate(weak))
+
+    # f+1 claims all attesting bounds >= base: accepted
+    good_cert = (
+        _cp_claim(2, {1: 10}),
+        _cp_claim(3, {1: 12}),
+    )
+    good = ViewChange(
+        replica_id=1, new_view=1, log=(entry,), ui=UI(counter=12),
+        log_base=10, checkpoint_cert=good_cert,
+    )
+    asyncio.run(validate(good))
+
+    # ...but the retained counters must still extend the base contiguously
+    gap = ViewChange(
+        replica_id=1, new_view=1, log=(entry,), ui=UI(counter=12),
+        log_base=9, checkpoint_cert=good_cert,
+    )
+    with pytest.raises(api.AuthenticationError, match="gap"):
+        asyncio.run(validate(gap))
+
+
+def test_vc_stub_must_be_covered_by_certificate():
+    from minbft_tpu.messages.authen import collection_digest
+
+    validate = _truncating_validator()
+    cert = (_cp_claim(2, {1: 0}), _cp_claim(3, {1: 0}))  # position (0, 50)
+
+    def stub_commit(counter, batch_cv):
+        # The sender's COMMIT at its own ``counter``, embedding the
+        # PRIMARY's prepare for batch ``batch_cv`` stubbed down to its
+        # digest — the shape truncation actually produces.
+        full = _prepare(batch_cv, primary=0)
+        stub_p = Prepare(
+            replica_id=0, view=0, requests=(),
+            ui=UI(counter=batch_cv, cert=b"c"),
+            requests_digest=collection_digest(full.requests, b""),
+        )
+        return Commit(replica_id=1, prepare=stub_p, ui=UI(counter=counter, cert=b"c"))
+
+    # a stubbed commit to batch cv 40 <= certified cv 50: covered, accepted
+    covered = ViewChange(
+        replica_id=1, new_view=1, log=(stub_commit(1, 40),),
+        ui=UI(counter=2), checkpoint_cert=cert,
+    )
+    asyncio.run(validate(covered))
+
+    # batch cv 60 > certified 50: stubbing it would hide LIVE commit
+    # evidence -> rejected
+    uncovered = ViewChange(
+        replica_id=1, new_view=1, log=(stub_commit(1, 60),),
+        ui=UI(counter=2), checkpoint_cert=cert,
+    )
+    with pytest.raises(api.AuthenticationError, match="does not cover"):
+        asyncio.run(validate(uncovered))
+
+    # a stub with NO certificate at all: nothing proves coverage
+    naked = ViewChange(
+        replica_id=1, new_view=1, log=(stub_commit(1, 40),),
+        ui=UI(counter=2),
+    )
+    with pytest.raises(api.AuthenticationError, match="does not cover"):
+        asyncio.run(validate(naked))
+
+
+def test_checkpoint_cert_validator_shape():
+    """The certificate itself: f+1 distinct matching signature-verified
+    claims — mismatches, duplicates, and short certs are refused."""
+    from minbft_tpu.core import checkpoint as cp_mod
+
+    async def verify_signature(msg):
+        return None
+
+    validate_cert = cp_mod.make_cert_validator(1, verify_signature)
+
+    ok = (_cp_claim(2, {1: 5}), _cp_claim(3, {1: 7}))
+    assert asyncio.run(validate_cert(ok)).count == 100
+
+    with pytest.raises(api.AuthenticationError, match="f\\+1"):
+        asyncio.run(validate_cert((_cp_claim(2, {1: 5}),)))
+
+    with pytest.raises(api.AuthenticationError, match="duplicate"):
+        asyncio.run(validate_cert((_cp_claim(2, {1: 5}), _cp_claim(2, {1: 6}))))
+
+    with pytest.raises(api.AuthenticationError, match="match"):
+        asyncio.run(
+            validate_cert(
+                (_cp_claim(2, {1: 5}), _cp_claim(3, {1: 5}, digest=b"X" * 32))
+            )
+        )
