@@ -226,9 +226,11 @@ def _bench_cluster_repeated(*args, **kw) -> dict:
         # Wedge forensics, armed while the run is LIVE: dumping from the
         # except block would be too late — asyncio.run's teardown joins
         # the (possibly hung) executor threads first and cancels every
-        # task stack.  A slow-but-honest cold run tripping this is just
-        # harmless stderr noise (exit=False).
-        faulthandler.dump_traceback_later(300, exit=False, file=sys.stderr)
+        # task stack.  Must fire BEFORE the 240s per-request deadline
+        # unwinds the run (a healthy run finishes in well under 180s even
+        # with in-run kernel warming); a slow-but-honest run tripping
+        # this is harmless stderr noise (exit=False).
+        faulthandler.dump_traceback_later(180, exit=False, file=sys.stderr)
         try:
             out = asyncio.run(_bench_cluster(*args, **kw))
         except (asyncio.TimeoutError, TimeoutError):

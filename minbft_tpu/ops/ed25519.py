@@ -424,20 +424,24 @@ def sign_batch(
     if total > chunk:
         total = -(-total // chunk) * chunk
     pad = total - b
-    pubs: dict = {}
+    # Per-seed derivation cache: the production shape is ONE signer, many
+    # messages — the SHA-512 seed expansion, clamp, and public key are
+    # computed once per distinct seed, not per item.
+    per_seed: dict = {}
     rs = []
     meta = []
     r_arr = np.zeros((total, limbs.NLIMBS), np.uint32)
     for i, (seed, msg) in enumerate(items):
-        h = hashlib.sha512(seed).digest()
-        a = int.from_bytes(h[:32], "little")
-        a = (a & ((1 << 254) - 8)) | (1 << 254)
-        pub = pubs.get(seed)
-        if pub is None:
-            pub = hc.ed25519_keygen(seed)[1]
-            pubs[seed] = pub
+        entry = per_seed.get(seed)
+        if entry is None:
+            h = hashlib.sha512(seed).digest()
+            a = int.from_bytes(h[:32], "little")
+            a = (a & ((1 << 254) - 8)) | (1 << 254)
+            entry = (a, h[32:], hc.ed25519_keygen(seed)[1])
+            per_seed[seed] = entry
+        a, prefix, pub = entry
         r = (
-            int.from_bytes(hashlib.sha512(h[32:] + msg).digest(), "little")
+            int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little")
             % L
         )
         rs.append(r)
@@ -453,12 +457,11 @@ def sign_batch(
     ]
     xyz = np.concatenate([np.asarray(o) for o in outs])[:b]  # [B,3,16] u16
 
-    rinv = pow(1 << 256, -1, P)  # undo the Montgomery factor
+    # No Montgomery undo needed: the R factor cancels in the X/Z and Y/Z
+    # ratios ((X*R) * (Z*R)^-1 == X/Z), so the raw device limbs feed the
+    # batch inversion directly.
     ints = [
-        [
-            int.from_bytes(row.astype("<u2").tobytes(), "little") * rinv % P
-            for row in lane
-        ]
+        [int.from_bytes(row.astype("<u2").tobytes(), "little") for row in lane]
         for lane in xyz
     ]
     z_invs = _batch_inv([lane[2] for lane in ints], P)
