@@ -400,7 +400,11 @@ async def _bench_cluster(
     clients = []
     for c in range(n_clients):
         client = new_client(
-            c, n, f, client_auths[c], InProcessClientConnector(stubs), seq_start=0
+            c, n, f, client_auths[c], InProcessClientConnector(stubs),
+            seq_start=0,
+            # Heal rare losses instead of wedging a run: an unanswered
+            # request is re-broadcast (dedup makes retries harmless).
+            retransmit_interval=30.0,
         )
         await client.start()
         clients.append(client)

@@ -26,11 +26,37 @@ class ClientState:
     reference keeps a single last-reply slot, reply.go:25-60, and scalar
     seq watermarks, request-seq.go:28-45)."""
 
+    # Out-of-order tolerance window: completed-but-unretired seqs above
+    # the retire watermark are remembered individually so a LOWER seq
+    # arriving late is not mistaken for a duplicate.  Bounded at roughly
+    # any sane client pipeline depth; beyond it, oldest entries fall out
+    # (dedup degrades to the watermark for ancient seqs — the reply
+    # window's philosophy).
+    _DONE_WINDOW = 1024
+
     def __init__(self, timer_provider: TimerProvider):
         self._timers = timer_provider
-        # request-seq state machine (reference request-seq.go:28-45)
-        self._last_captured = 0
-        self._last_released = 0
+        # Request-seq state machine.  The reference keeps scalar
+        # captured/released watermarks (request-seq.go:28-45) — sound
+        # there because its client is strictly serial (requestbuffer's
+        # single slot), so seqs ARRIVE in order.  This build's clients
+        # pipeline many requests, and concurrent per-message tasks mean a
+        # higher seq can reach capture first; a scalar watermark would
+        # then silently DROP the lower seq as a "duplicate" — never
+        # proposed, and later retired past by the watermark jump (a
+        # liveness hole observed live at ~1 in 10 flagship bench runs).
+        # Capture instead tracks the single ACTIVE seq plus a bounded set
+        # of completed seqs above the retire watermark.
+        self._last_captured = 0  # max captured (diagnostic watermark)
+        self._active = 0  # captured, not yet released (0 = none)
+        self._done: set = set()  # released seqs > _retired
+        # Everything at or below this floor is treated as a duplicate:
+        # when the done-set overflows, evicted seqs RAISE the floor
+        # instead of silently losing their dedup (a dropped dedup would
+        # let a retransmit re-execute an already-processed request —
+        # safety; a floor refusing a very late lower seq costs only
+        # liveness, and only beyond a 1024-deep reorder).
+        self._done_floor = 0
         self._last_prepared = 0
         self._retired = 0
         self._cond = asyncio.Condition()
@@ -53,44 +79,62 @@ class ClientState:
 
     # -- request sequence lifecycle -----------------------------------------
 
+    def _is_dup(self, seq: int) -> bool:
+        return (
+            seq <= self._retired
+            or seq <= self._done_floor
+            or seq == self._active
+            or seq in self._done
+        )
+
     async def capture_request_seq(self, seq: int) -> bool:
         """Capture ``seq`` for processing.
 
-        Returns False if ``seq`` was already captured (duplicate).  Blocks
-        while a prior capture is unreleased (the per-client serialization of
-        reference request-seq.go:47-82)."""
-        # Duplicate fast path: ``_last_captured`` only grows, and on the
-        # single-threaded event loop it cannot change between this check
-        # and the return — the condvar is only needed to *capture*.
-        # (Duplicates dominate: every peer message re-offers its embedded
-        # requests.)
-        if seq <= self._last_captured:
+        Returns False if ``seq`` was already captured/retired (duplicate).
+        Blocks while a DIFFERENT capture is unreleased (the per-client
+        serialization of reference request-seq.go:47-82).  Out-of-order
+        arrivals are fine: a lower seq arriving after a higher one still
+        captures (see the constructor note)."""
+        # Duplicate fast path: on the single-threaded event loop nothing
+        # changes between this check and the return — the condvar is only
+        # needed to *capture*.  (Duplicates dominate: every peer message
+        # re-offers its embedded requests.)
+        if self._is_dup(seq):
             return False
         async with self._cond:
-            while self._last_captured != self._last_released:
-                if seq <= self._last_captured:
+            while True:
+                if self._is_dup(seq):
                     return False
+                if self._active == 0:
+                    self._active = seq
+                    if seq > self._last_captured:
+                        self._last_captured = seq
+                    return True
                 await self._cond.wait()
-            if seq <= self._last_captured:
-                return False
-            self._last_captured = seq
-            return True
 
     async def release_request_seq(self, seq: int) -> None:
         """Finish processing a captured seq (reference request-seq.go:84-97)."""
         async with self._cond:
-            if seq != self._last_captured or self._last_released == seq:
+            if seq != self._active:
                 raise ValueError("release of non-captured request seq")
-            self._last_released = seq
+            self._active = 0
+            if seq > self._retired:
+                self._done.add(seq)
+                if len(self._done) > self._DONE_WINDOW:
+                    evicted = min(self._done)
+                    self._done.discard(evicted)
+                    if evicted > self._done_floor:
+                        self._done_floor = evicted
             self._cond.notify_all()
 
     def prepare_request_seq(self, seq: int) -> None:
-        """Mark ``seq`` prepared (reference request-seq.go:99-106).  A
-        scalar watermark suffices: seqs are captured one-at-a-time per
-        client, so at most one seq is between captured and retired.
-        Nothing reads the watermark yet — like the reference's prepared
-        flag it exists for the view-change path (retransmitting prepared-
-        but-unexecuted requests), which is roadmap in both builds."""
+        """Mark ``seq`` prepared (reference request-seq.go:99-106).
+        NOTE: with the out-of-order capture model, MANY seqs can sit
+        between prepared and retired, so this scalar watermark cannot
+        enumerate prepared-but-unexecuted requests — anything built on it
+        (e.g. a view-change retransmission of prepared requests) must use
+        the pending request list, not this field.  Nothing reads it yet;
+        kept for reference parity."""
         if seq > self._last_prepared:
             self._last_prepared = seq
 
@@ -100,10 +144,16 @@ class ClientState:
 
     def retire_request_seq(self, seq: int) -> bool:
         """Mark ``seq`` executed; returns False if already retired
-        (reference request-seq.go:108-112)."""
+        (reference request-seq.go:108-112).  The watermark-jump semantics
+        are preserved — the collector executes in a deterministic global
+        order, so seqs below an executed one are genuinely superseded —
+        and completed seqs at or below the new watermark leave the done
+        set (memory stays O(pipeline depth))."""
         if seq <= self._retired:
             return False
         self._retired = seq
+        if self._done:
+            self._done = {s for s in self._done if s > seq}
         return True
 
     @property
@@ -121,9 +171,9 @@ class ClientState:
         if seq <= self._retired:
             return
         self._retired = seq
+        if self._done:
+            self._done = {s for s in self._done if s > seq}
         if self._last_captured < seq:
-            if self._last_released == self._last_captured:
-                self._last_released = seq
             self._last_captured = seq
         if self._last_prepared < seq:
             self._last_prepared = seq

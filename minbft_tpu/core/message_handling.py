@@ -1300,12 +1300,27 @@ class Handlers:
     # Top-level handlers (reference handleClientMessage / handlePeerMessage /
     # handleOwnMessage, core/message-handling.go:352-403).
 
-    async def handle_client_message(self, msg: Message) -> Optional[Reply]:
+    async def handle_client_message(
+        self, msg: Message, turn=None
+    ) -> Optional[Reply]:
         if not isinstance(msg, Request):
             raise api.AuthenticationError("client stream accepts only REQUEST")
         self.metrics.inc("messages_handled")
         self.metrics.inc("requests_received")
         await self.validate_message(msg)
+        if turn is not None:
+            # Concurrent validations may complete out of order; capture
+            # must happen in arrival order (see _TurnSequencer).  The turn
+            # is released the moment processing ends — holding it across
+            # the reply quorum wait below would serialize the pipeline to
+            # one request per client.
+            sequencer, t = turn
+            await sequencer.wait_turn(t)
+            try:
+                await self.process_message(msg)
+            finally:
+                sequencer.finish(t)
+            return await self.reply_request(msg)
         await self.process_message(msg)
         # Reply once executed (even to a duplicate request — the client may
         # be retrying a lost reply, reference message-handling.go:396-403).
@@ -1450,6 +1465,55 @@ class _ConcurrentStreamProcessor:
             t.cancel()
 
 
+class _TurnSequencer:
+    """Restores ARRIVAL order between concurrent per-message tasks.
+
+    Client-stream messages are validated concurrently (so verification
+    co-batches on the engine), but per-client seq capture assumes seqs
+    arrive in order — the client enqueues them in seq order and the
+    stream is FIFO, yet validation completes out of order, and a higher
+    seq reaching capture first makes the retire watermark jump past the
+    lower one (silently wedging it; observed at ~1 in 10 flagship bench
+    runs).  Each message takes a ticket at arrival; after validating, it
+    waits its turn before the stateful processing step and releases the
+    turn right after (never across the reply quorum wait, which would
+    serialize the pipeline).  A ticket is released on EVERY exit —
+    including validation failure — so a rejected message never wedges
+    the queue behind it."""
+
+    def __init__(self):
+        self._issue = 0
+        self._next = 0
+        self._completed: set = set()
+        self._events: Dict[int, asyncio.Event] = {}
+
+    def ticket(self) -> int:
+        t = self._issue
+        self._issue += 1
+        return t
+
+    async def wait_turn(self, t: int) -> None:
+        if self._next == t:
+            return
+        ev = self._events.setdefault(t, asyncio.Event())
+        await ev.wait()
+
+    def finish(self, t: int) -> None:
+        """Idempotent: the happy path finishes right after processing
+        (before the reply wait) and the error path finishes again from
+        its finally."""
+        if t < self._next or t in self._completed:
+            return
+        self._completed.add(t)
+        while self._next in self._completed:
+            self._completed.discard(self._next)
+            self._events.pop(self._next, None)
+            self._next += 1
+        ev = self._events.get(self._next)
+        if ev is not None:
+            ev.set()
+
+
 class PeerStreamHandler(api.MessageStreamHandler):
     """Server side of a peer connection: expect HELLO, then stream the
     broadcast log + the hello sender's unicast log
@@ -1522,9 +1586,17 @@ class ClientStreamHandler(api.MessageStreamHandler):
         h = self.handlers
         out_queue: asyncio.Queue = asyncio.Queue()
         FIN = object()
+        turns = _TurnSequencer()
 
         async def handle_one(msg: Message) -> None:
-            reply = await h.handle_client_message(msg)
+            t = turns.ticket()
+            try:
+                reply = await h.handle_client_message(msg, turn=(turns, t))
+            finally:
+                # Every exit — validation failure included — releases the
+                # turn, or every later message on this stream would wedge
+                # behind it.
+                turns.finish(t)
             if reply is None:
                 # Stale retry of a superseded seq: the last-reply buffer
                 # skipped past it (reference ReplyChannel closes without

@@ -331,3 +331,56 @@ def test_viewstate_concurrent_leases_are_shared():
         assert active["max"] > 1  # leases overlap (no reader serialization)
 
     asyncio.run(run())
+
+
+def test_clientstate_out_of_order_capture_not_dropped():
+    """The round-4 wedge: a pipelined client's requests are processed by
+    concurrent per-message tasks, so a HIGHER seq can reach capture first.
+    A scalar captured-watermark (the reference's serial-client semantics)
+    would drop the lower seq as a 'duplicate' — never proposed, silently
+    retired past, request wedged forever.  Captures must tolerate
+    out-of-order arrival while keeping the one-at-a-time gate and full
+    dedup."""
+
+    async def run():
+        st = ClientState(FakeTimerProvider())
+        # seq 89 arrives and completes first...
+        assert await st.capture_request_seq(89)
+        await st.release_request_seq(89)
+        # ...then seq 73 arrives late: it must still capture
+        assert await st.capture_request_seq(73)
+        await st.release_request_seq(73)
+        # both are now duplicates
+        assert not await st.capture_request_seq(89)
+        assert not await st.capture_request_seq(73)
+        # execution retires at 89 (watermark jump) — everything at or
+        # below dedups, the done-set is pruned
+        assert st.retire_request_seq(89)
+        assert not await st.capture_request_seq(80)
+        assert st._done == set()
+        # a genuinely new seq still works
+        assert await st.capture_request_seq(90)
+        await st.release_request_seq(90)
+
+    asyncio.run(run())
+
+
+def test_clientstate_done_window_overflow_raises_floor():
+    """Overflowing the done-window must not LOSE dedup (a retransmit of an
+    evicted seq would re-execute): evicted seqs raise a duplicate floor —
+    conservative refusal, never re-capture."""
+
+    async def run():
+        st = ClientState(FakeTimerProvider())
+        st._DONE_WINDOW = 4
+        for seq in (10, 20, 30, 40, 50):
+            assert await st.capture_request_seq(seq)
+            await st.release_request_seq(seq)
+        # window 4: seq 10 was evicted, floor raised to it
+        assert st._done_floor == 10
+        assert not await st.capture_request_seq(10)  # still a duplicate
+        assert not await st.capture_request_seq(7)   # below the floor: refused
+        assert await st.capture_request_seq(60)      # fresh seqs unaffected
+        await st.release_request_seq(60)
+
+    asyncio.run(run())
