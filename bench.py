@@ -542,10 +542,12 @@ async def _bench_cluster(
 
 
 def main() -> None:
-    # 16384 lanes amortize the per-dispatch overhead of remote-attached
-    # chips (~13ms/launch on the tunneled bench host): measured 150k
-    # verifies/s vs 113k at 4096 on the same chip, same kernel.
-    batch = int(os.environ.get("MINBFT_BENCH_BATCH", "16384"))
+    # Large batches amortize the per-dispatch overhead of remote-attached
+    # chips (~13ms/launch on the tunneled bench host): measured 113k
+    # verifies/s at 4096 -> 153k at 16384 -> 162k at 32768 on the same
+    # chip, same kernel (diminishing: the kernel is compute-bound by
+    # 32768).
+    batch = int(os.environ.get("MINBFT_BENCH_BATCH", "32768"))
     n_requests = int(os.environ.get("MINBFT_BENCH_REQUESTS", "10000"))
     n_clients = int(os.environ.get("MINBFT_BENCH_CLIENTS", "100"))
 
