@@ -434,6 +434,15 @@ class Handlers:
         async def on_batch_end(view: int, cv: int) -> None:
             self._exec_pos = (view, cv)
             await self.checkpoint_emitter.on_batch_end(view, cv)
+            if self._pending_new_view is not None:
+                # Ordinary log replay can carry the checkpoint count past
+                # a deferred NEW-VIEW's anchor without any snapshot ever
+                # installing.  Applying advances the view, which drains
+                # the read lease this execution path runs under — so the
+                # re-check must run as its own task, outside the lease.
+                asyncio.get_running_loop().create_task(
+                    self._maybe_apply_pending_new_view()
+                )
 
         self._prepare_batcher = _PrepareBatcher(
             replica_id,
@@ -1063,6 +1072,13 @@ class Handlers:
             if self._snapshot_timer is not None:
                 self._snapshot_timer.cancel()
                 self._snapshot_timer = None
+            # A NEW-VIEW deferred behind this transfer must not die with
+            # it: the catch-up that made the snapshot stale may equally
+            # have carried us past the NEW-VIEW's anchor (and if it did
+            # not, the re-check restarts the transfer) — otherwise the
+            # replica stays wedged in the old view, silently consuming
+            # the fault budget.
+            await self._maybe_apply_pending_new_view()
             return False
         try:
             app_digest = self.consumer.snapshot_digest(resp.app_state)
@@ -1106,13 +1122,41 @@ class Handlers:
         if resp.view > cur:
             await self.view_state.advance_expected_view(resp.view)
             await self.view_state.advance_current_view(resp.view)
-        nv = self._pending_new_view
-        if nv is not None:
-            anchor_count = viewchange_mod.quorum_anchor(nv.view_changes)[0]
-            if self.checkpoint_emitter.count >= anchor_count:
-                self._pending_new_view = None
-                await self._apply_new_view(nv)
+        await self._maybe_apply_pending_new_view()
         return True
+
+    async def _maybe_apply_pending_new_view(self) -> None:
+        """Retry a NEW-VIEW that was deferred behind a state transfer.
+
+        Re-applies once the local checkpoint count reaches the NEW-VIEW's
+        quorum anchor, OR when no transfer is in flight anymore (the
+        deferred entry's transfer was dropped): in the latter case
+        ``_apply_new_view`` re-defers and re-requests the anchor state
+        itself, so calling it is always safe.  Must be invoked outside the
+        view read lease — applying advances the view, which drains leases.
+        """
+        nv = self._pending_new_view
+        if nv is None:
+            return
+        anchor_count = viewchange_mod.quorum_anchor(nv.view_changes)[0]
+        if (
+            self.checkpoint_emitter.count < anchor_count
+            and self._snapshot_expect is not None
+        ):
+            return  # still legitimately waiting on the in-flight transfer
+        self._pending_new_view = None
+        try:
+            await self._apply_new_view(nv)
+        except Exception:
+            # An apply failure must not lose the NEW-VIEW forever (it was
+            # already captured, so it is never redelivered) — especially on
+            # the batch-end path, where this runs in a fire-and-forget task
+            # and the exception would otherwise vanish.  _apply_new_view
+            # may itself have re-deferred (set a fresh pending) before
+            # raising; only restore if it didn't.
+            if self._pending_new_view is None:
+                self._pending_new_view = nv
+            raise
 
     # ------------------------------------------------------------------
     # View-change protocol steps (beyond reference — core/viewchange.py).
