@@ -8,6 +8,7 @@ from .. import api
 from ..messages import (
     Checkpoint,
     Commit,
+    Hello,
     Message,
     Prepare,
     ReqViewChange,
@@ -28,7 +29,10 @@ def signing_role(msg: Message) -> api.AuthenticationRole:
     (reference core/utils.go:43-72 message-type → role mapping)."""
     if isinstance(msg, Request):
         return api.AuthenticationRole.CLIENT
-    if isinstance(msg, (Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp)):
+    if isinstance(
+        msg,
+        (Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp, Hello),
+    ):
         return api.AuthenticationRole.REPLICA
     raise TypeError(f"{type(msg).__name__} is not a signed message")
 

@@ -29,6 +29,7 @@ from . import codec
 from .message import (
     Checkpoint,
     Commit,
+    Hello,
     Message,
     NewView,
     Prepare,
@@ -147,6 +148,8 @@ def _authen_bytes(m: Message) -> bytes:
             + _sha256(m.digest)
             + h.digest()
         )
+    if isinstance(m, Hello):
+        return b"HELLO" + _U32.pack(m.replica_id)
     if isinstance(m, SnapshotReq):
         return b"SNAPSHOT-REQ" + _U32.pack(m.replica_id) + _U64.pack(m.count)
     if isinstance(m, SnapshotResp):

@@ -122,7 +122,11 @@ def marshal(m: Message) -> bytes:
     """Serialize a message to canonical bytes
     (reference messages/protobuf/impl.go:87-107 equivalent)."""
     if isinstance(m, Hello):
-        return bytes([_TAG_HELLO]) + _pack_u32(m.replica_id)
+        return (
+            bytes([_TAG_HELLO])
+            + _pack_u32(m.replica_id)
+            + _pack_bytes(m.signature)
+        )
     if isinstance(m, Request):
         return (
             bytes([_TAG_REQUEST])
@@ -299,7 +303,8 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
     off += 1
     if tag == _TAG_HELLO:
         rid, off = _read_u32(data, off)
-        return Hello(replica_id=rid), off
+        sig, off = _read_bytes(data, off)
+        return Hello(replica_id=rid, signature=sig), off
     if tag == _TAG_REQUEST:
         cid, off = _read_u32(data, off)
         seq, off = _read_u64(data, off)

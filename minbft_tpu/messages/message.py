@@ -62,10 +62,21 @@ class Hello(Message):
     Sent once when a replica opens a peer connection; the receiver responds by
     streaming its broadcast + unicast-to-that-peer message logs
     (reference core/message-handling.go:269-290, 316-350).
+
+    **Signed** (beyond the reference, which binds the unicast replay to an
+    unauthenticated id — reference core/message-handling.go:316-350): the
+    receiver verifies the replica signature over the claimed id before
+    attaching the sender's unicast log, so an id-spoofing peer cannot
+    subscribe to another replica's unicast stream.  A *replayed* signed
+    HELLO still subscribes the replayer — harmless by design: unicast logs
+    carry only signed/USIG-certified protocol messages (no confidentiality
+    claim), and log streams are replay-then-follow, so an extra subscriber
+    steals nothing from the genuine peer.
     """
 
     KIND = "HELLO"
     replica_id: int
+    signature: bytes = b""
 
 
 @dataclasses.dataclass

@@ -86,7 +86,18 @@ class _SchemeQueue:
     """
 
     _MEMO_CAP = 16384
+    # Failed verdicts live in their own, much smaller LRU: a flood of
+    # distinct garbage signatures must not evict known-GOOD verdicts and
+    # re-drive device traffic for them (round-4 verdict weak #7).  Small
+    # because negative hits only matter for byzantine *retransmissions* of
+    # the same bad item — there is no protocol reason to remember many.
+    _NEG_MEMO_CAP = 512
     _WRITE_OFF_AFTER = 3  # CONSECUTIVE hung dispatches before host-only
+    _REPROBE_AFTER = 600.0  # s before a written-off device is re-tried
+    # Cold kernel compiles (unrolled ECDSA/Ed25519 shapes take minutes on
+    # a cold cache) land inside the FIRST dispatch: give it headroom so a
+    # slow-but-healthy compile is not misread as a hung tunnel.
+    _FIRST_TIMEOUT_FACTOR = 4
 
     def __init__(self, engine: "BatchVerifier", name: str, dispatch):
         self.engine = engine
@@ -97,14 +108,23 @@ class _SchemeQueue:
         self.inflight = 0
         self.stats = VerifyStats()
         self._memo: "OrderedDict[object, bool]" = OrderedDict()
+        self._neg_memo: "OrderedDict[object, bool]" = OrderedDict()
         self._inflight_futs: Dict[object, asyncio.Future] = {}
         self._consecutive_timeouts = 0
         self._device_written_off = False
+        self._device_ever_succeeded = False
+        self._written_off_at = 0.0
+        self._probing = False
 
     def submit(self, item) -> "asyncio.Future | _Resolved":
         verdict = self._memo.get(item)
+        if verdict is None:
+            verdict = self._neg_memo.get(item)
+            memo = self._neg_memo
+        else:
+            memo = self._memo
         if verdict is not None:
-            self._memo.move_to_end(item)
+            memo.move_to_end(item)
             self.stats.memo_hits += 1
             return _Resolved(verdict)
         loop = asyncio.get_running_loop()
@@ -166,15 +186,19 @@ class _SchemeQueue:
         st.batches += 1
         st.max_batch_seen = max(st.max_batch_seen, len(batch))
         st.device_time_s += dt
-        memo = self._memo
         for (it, _), ok in zip(batch, results):
             ok = bool(ok)
-            memo[it] = ok  # pure function: verdicts (both ways) are stable
+            # Pure function: verdicts (both ways) are stable — but they
+            # age out of segregated LRUs so garbage cannot evict good.
+            memo = self._memo if ok else self._neg_memo
+            memo[it] = ok
             for fut in self._inflight_futs.pop(it, ()):
                 if not fut.done():
                     fut.set_result(ok)
-        while len(memo) > self._MEMO_CAP:
-            memo.popitem(last=False)
+        while len(self._memo) > self._MEMO_CAP:
+            self._memo.popitem(last=False)
+        while len(self._neg_memo) > self._NEG_MEMO_CAP:
+            self._neg_memo.popitem(last=False)
 
     async def _dispatch_with_fallback(self, items):
         """Run the dispatcher with a liveness net: on remote-attached
@@ -191,11 +215,26 @@ class _SchemeQueue:
         if fallback is None or timeout <= 0:
             return await asyncio.to_thread(self.dispatch, items)
         if self._device_written_off:
+            # The write-off is a demotion, not a death sentence: after
+            # _REPROBE_AFTER a duplicate of this batch re-tries the device
+            # OUT-OF-BAND (one at a time — _probing gates) and restores
+            # the queue on success.  The live batch always goes straight
+            # to the fallback: a probe of a still-dead device must never
+            # hold protocol verifications hostage for its timeout.
+            due = time.monotonic() - self._written_off_at >= self._REPROBE_AFTER
+            if due and not self._probing:
+                self._probing = True
+                asyncio.get_running_loop().create_task(self._probe(list(items)))
             return await asyncio.to_thread(fallback, items)
+        if not self._device_ever_succeeded:
+            # Cold compile may be inside this dispatch — see
+            # _FIRST_TIMEOUT_FACTOR.
+            timeout *= self._FIRST_TIMEOUT_FACTOR
         task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
         try:
             results = await asyncio.wait_for(asyncio.shield(task), timeout)
             self._consecutive_timeouts = 0  # the device is healthy again
+            self._device_ever_succeeded = True
             return results
         except asyncio.TimeoutError:
             # Abandon the hung thread; swallow whatever it eventually
@@ -208,6 +247,7 @@ class _SchemeQueue:
             self._consecutive_timeouts += 1
             if self._consecutive_timeouts >= self._WRITE_OFF_AFTER:
                 self._device_written_off = True
+                self._written_off_at = time.monotonic()
             import logging
 
             logging.getLogger("minbft.engine").error(
@@ -220,6 +260,35 @@ class _SchemeQueue:
                 len(items),
             )
             return await asyncio.to_thread(fallback, items)
+
+    async def _probe(self, items) -> None:
+        """Out-of-band re-probe of a written-off device with a duplicate
+        of a live batch (verification is pure; the duplicates' results are
+        discarded — the live batch resolved via the fallback).  Success
+        restores the device queue; failure re-arms the re-probe clock."""
+        import logging
+
+        task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(task), self.engine.dispatch_timeout
+            )
+            self._device_written_off = False
+            self._consecutive_timeouts = 0
+            self._device_ever_succeeded = True
+            logging.getLogger("minbft.engine").warning(
+                "%s device recovered on re-probe: restoring device queue",
+                self.name,
+            )
+        except asyncio.TimeoutError:
+            task.add_done_callback(
+                lambda t: t.exception() if not t.cancelled() else None
+            )
+            self._written_off_at = time.monotonic()
+        except Exception:
+            self._written_off_at = time.monotonic()
+        finally:
+            self._probing = False
 
 
 class BatchVerifier:
@@ -362,6 +431,26 @@ class BatchVerifier:
 
     async def verify_ed25519_host(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
         q = self._queue("ed25519_host", self._dispatch_ed25519_host)
+        return await q.submit((pub, msg, sig))
+
+    async def verify_nist_host(
+        self, curve: str, pub: bytes, msg: bytes, sig: bytes
+    ) -> bool:
+        """Host-queue verification for the wider NIST curves (P-384/P-521
+        have no TPU kernel): worker-thread OpenSSL behind the same dedup
+        memo + thread-hop batching as the other host queues."""
+        name = f"ecdsa_{curve}_host"
+        q = self._queues.get(name)
+        if q is None:
+            from ..utils import hostcrypto as hc
+
+            def dispatch(items, _curve=curve):
+                return np.array(
+                    [hc.nist_verify(_curve, p, m, s) for p, m, s in items],
+                    dtype=bool,
+                )
+
+            q = self._queue(name, dispatch)
         return await q.submit((pub, msg, sig))
 
     # -- dispatchers (worker thread; jax work happens here) -----------------

@@ -42,6 +42,9 @@ class SigScheme:
     signature once) without the device round trip."""
 
     name = "?"
+    # Whether a TPU batch-verify kernel exists for this scheme; the
+    # authenticator routes device-incapable schemes to the host path.
+    device_capable = True
 
     def sign(self, priv, msg: bytes) -> bytes:
         raise NotImplementedError
@@ -89,7 +92,46 @@ class Ed25519Scheme(SigScheme):
         return hc.ed25519_verify(pub, digest, tag)
 
 
-SCHEMES = {s.name: s for s in (EcdsaScheme(), Ed25519Scheme())}
+class NistEcdsaScheme(SigScheme):
+    """Wider NIST curves, HOST path only (reference keymanager.go:169-241
+    accepts P-224..P-521 keys; this build serves P-384/P-521).  There is
+    deliberately no TPU kernel for these curves — the device queue rejects
+    with a clear error rather than silently degrading, and the normal
+    routing never sends them there."""
+
+    device_capable = False
+
+    def __init__(self, curve: str):
+        self.name = f"ecdsa-{curve}"
+        self._curve = curve
+
+    def sign(self, priv: bytes, msg: bytes) -> bytes:
+        return hc.nist_sign(self._curve, priv, msg)
+
+    async def verify(
+        self, pub: bytes, msg: bytes, tag: bytes, engine, device=True
+    ) -> bool:
+        if engine is not None:
+            if device:
+                raise api.AuthenticationError(
+                    f"{self.name} has no TPU verify kernel: host path only "
+                    "(only ecdsa-p256 / ed25519 batch on device)"
+                )
+            # Engine host queue: cluster-wide dedup memo + worker-thread
+            # OpenSSL, same placement as the sibling schemes' host path.
+            return await engine.verify_nist_host(self._curve, pub, msg, tag)
+        return hc.nist_verify(self._curve, pub, msg, tag)
+
+
+SCHEMES = {
+    s.name: s
+    for s in (
+        EcdsaScheme(),
+        Ed25519Scheme(),
+        NistEcdsaScheme("p384"),
+        NistEcdsaScheme("p521"),
+    )
+}
 
 
 class SampleAuthenticator(api.Authenticator):
@@ -190,7 +232,7 @@ class SampleAuthenticator(api.Authenticator):
         # otherwise the engine's host queue (dedup without device round
         # trips) when an engine exists; plain inline verification when not.
         sig_engine = self._engine
-        sig_device = self._batch_signatures
+        sig_device = self._batch_signatures and self._scheme.device_capable
         if role == api.AuthenticationRole.CLIENT:
             pub = self._client_pubs.get(peer_id)
             if pub is None:

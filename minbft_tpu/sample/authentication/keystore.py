@@ -73,9 +73,25 @@ def _ed25519_decode(priv: Optional[bytes], pub: bytes):
     return priv, pub
 
 
+def _nist_generate(curve: str):
+    def gen() -> Tuple[bytes, bytes]:
+        return hc.nist_keygen(curve)
+
+    return gen
+
+
+def _nist_decode(priv: Optional[bytes], pub: bytes):
+    return priv, pub
+
+
 _SIG_SPECS = {
     "ECDSA_P256": ("ecdsa-p256", _ecdsa_generate, _ecdsa_decode),
     "ED25519": ("ed25519", _ed25519_generate, _ed25519_decode),
+    # Wider-curve keyspecs (reference keymanager.go:169-241 accepts
+    # P-224..P-521): host-path verification only — the TPU kernels are
+    # P-256/Ed25519; see authenticator.NistEcdsaScheme.
+    "ECDSA_P384": ("ecdsa-p384", _nist_generate("p384"), _nist_decode),
+    "ECDSA_P521": ("ecdsa-p521", _nist_generate("p521"), _nist_decode),
 }
 _SPEC_FOR_SCHEME = {v[0]: k for k, v in _SIG_SPECS.items()}
 

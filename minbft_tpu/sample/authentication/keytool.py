@@ -19,7 +19,7 @@ import sys
 
 from ..envflags import env_default
 
-_SCHEMES = ("ecdsa-p256", "ed25519")
+_SCHEMES = ("ecdsa-p256", "ed25519", "ecdsa-p384", "ecdsa-p521")
 _USIG_SPECS = ("auto", "NATIVE_ECDSA", "SOFT_ECDSA", "HMAC_SHA256")
 
 

@@ -33,28 +33,125 @@ def _env(name: str, fallback, choices=None):
     return env_default("PEER", name, fallback, choices)
 
 
-def build_parser() -> argparse.ArgumentParser:
+# Per-node options file (reference sample/peer/peer.yaml layered by viper
+# under flags/env, root.go:54-82).  Same precedence here:
+# flags > PEER_* env vars > options file > built-in defaults.
+_PEER_OPTION_SCHEMA = {
+    None: {"keys", "config", "log_level", "log_file", "auth"},
+    "run": {"listen", "batch", "metrics_interval"},
+    "request": {"client_id", "timeout"},
+}
+
+
+def load_peer_options(path: str, explicit: bool) -> dict:
+    """Load and validate a per-node ``peer.yaml``.  A missing DEFAULT path
+    is fine (no file, no layering); a missing explicitly-requested one is
+    an error.  Unknown keys fail loudly — a typo silently reverting an
+    option to its default is how misconfigured replicas limp into
+    clusters."""
+    if not os.path.exists(path):
+        if explicit:
+            raise SystemExit(f"peer: options file {path!r} not found")
+        return {}
+    import yaml
+
+    with open(path) as fh:
+        data = yaml.safe_load(fh) or {}
+    if not isinstance(data, dict):
+        raise SystemExit(f"peer: options file {path!r} must be a mapping")
+    def check_scalar(name: str, v) -> None:
+        # str() would happily stringify a YAML list/mapping into a bogus
+        # "path" — reject non-scalars here, where the message can say so.
+        if isinstance(v, (dict, list)):
+            raise SystemExit(
+                f"peer: option {name} in {path!r} must be a scalar, "
+                f"got {type(v).__name__}"
+            )
+
+    for key, val in data.items():
+        if key in _PEER_OPTION_SCHEMA[None]:
+            check_scalar(key, val)
+            continue
+        sub = _PEER_OPTION_SCHEMA.get(key)
+        if sub is None:
+            raise SystemExit(f"peer: unknown option {key!r} in {path!r}")
+        if not isinstance(val, dict):
+            raise SystemExit(
+                f"peer: section {key!r} in {path!r} must be a mapping"
+            )
+        for k, v in val.items():
+            if k not in sub:
+                raise SystemExit(
+                    f"peer: unknown option {key}.{k!r} in {path!r}"
+                )
+            check_scalar(f"{key}.{k}", v)
+    return data
+
+
+def peek_options_path(argv=None):
+    """Resolve the options-file path BEFORE full parsing (its values feed
+    the parser's defaults): --options flag > PEER_OPTIONS env > peer.yaml."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path = os.environ.get("PEER_OPTIONS", "peer.yaml")
+    explicit = "PEER_OPTIONS" in os.environ
+    for i, a in enumerate(argv):
+        if a == "--options" and i + 1 < len(argv):
+            path, explicit = argv[i + 1], True
+        elif a.startswith("--options="):
+            path, explicit = a.split("=", 1)[1], True
+    return path, explicit
+
+
+def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
+    options = options or {}
+
+    def _opt(name: str, fallback, section=None, choices=None):
+        src = options.get(section) if section else options
+        v = (src or {}).get(name, fallback)
+        if v is not fallback and v is not None:
+            try:
+                v = type(fallback)(v)
+            except (TypeError, ValueError):
+                raise SystemExit(
+                    f"peer: invalid options-file value {name}={v!r} "
+                    f"(expected {type(fallback).__name__})"
+                )
+            if choices is not None and v not in choices:
+                raise SystemExit(
+                    f"peer: invalid options-file value {name}={v!r} "
+                    f"(choose from {', '.join(map(str, choices))})"
+                )
+        elif v is None:
+            v = fallback
+        return _env(name, v, choices)
+
     p = argparse.ArgumentParser(prog="peer", description="minbft-tpu peer")
     p.add_argument(
-        "--keys", default=_env("keys", "keys.yaml"), help="keystore path"
+        "--options",
+        default=peek_options_path()[0],
+        help="per-node options file layered under env vars and flags "
+        "(default: peer.yaml if present)",
+    )
+    p.add_argument(
+        "--keys", default=_opt("keys", "keys.yaml"), help="keystore path"
     )
     p.add_argument(
         "--config",
-        default=_env("config", "consensus.yaml"),
+        default=_opt("config", "consensus.yaml"),
         help="consensus config path",
     )
     _levels = ("debug", "info", "warning", "error")
     p.add_argument(
         "--log-level",
-        default=_env("log_level", "info", choices=_levels),
+        default=_opt("log_level", "info", choices=_levels),
         choices=_levels,
     )
-    p.add_argument("--log-file", default=_env("log_file", "") or None)
+    p.add_argument("--log-file", default=_opt("log_file", "") or None)
     _auths = ("signatures", "mac")
     p.add_argument(
         "--auth",
         choices=_auths,
-        default=_env("auth", "signatures", choices=_auths),
+        default=_opt("auth", "signatures", choices=_auths),
         help="message authentication: public-key signatures (default) or "
         "pairwise MACs (keys.yaml needs a macs section: keytool --macs)",
     )
@@ -64,13 +161,13 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("id", type=int, help="replica id")
     r.add_argument(
         "--listen",
-        default=_env("listen", ""),
+        default=_opt("listen", "", section="run"),
         help="listen address (default: this id's addr from the config)",
     )
     r.add_argument(
         "--batch",
         type=int,
-        default=_env("batch", 512),
+        default=_opt("batch", 512, section="run"),
         help="max verification batch per kernel launch",
     )
     r.add_argument(
@@ -81,14 +178,18 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument(
         "--metrics-interval",
         type=float,
-        default=_env("metrics_interval", 0.0),
+        default=_opt("metrics_interval", 0.0, section="run"),
         help="log the protocol counters every N seconds (0 = off)",
     )
 
     q = sub.add_parser("request", help="submit request(s) as a client")
     q.add_argument("ops", nargs="*", help="operations (default: stdin lines)")
-    q.add_argument("--client-id", type=int, default=_env("client_id", 0))
-    q.add_argument("--timeout", type=float, default=_env("timeout", 30.0))
+    q.add_argument(
+        "--client-id", type=int, default=_opt("client_id", 0, section="request")
+    )
+    q.add_argument(
+        "--timeout", type=float, default=_opt("timeout", 30.0, section="request")
+    )
 
     sub.add_parser("selftest", help="in-process n=4 cluster smoke test")
 
@@ -346,16 +447,36 @@ def _run_testnet_scaffold(args) -> int:
     cfg_path = os.path.join(args.dir, "consensus.yaml")
     with open(cfg_path, "w") as fh:
         yaml.safe_dump(cfg, fh, sort_keys=False)
+    # Sample per-node options file (reference ships sample/peer/peer.yaml):
+    # picked up automatically by `peer` run from this directory; every
+    # value still overridable by PEER_* env vars and flags.
+    peer_path = os.path.join(args.dir, "peer.yaml")
+    if not os.path.exists(peer_path):
+        with open(peer_path, "w") as fh:
+            fh.write(
+                "# Per-node peer options (layered under PEER_* env vars"
+                " and flags)\n"
+                "keys: keys.yaml\n"
+                "config: consensus.yaml\n"
+                "log_level: info\n"
+                "#run:\n"
+                "#  batch: 512\n"
+                "#  metrics_interval: 0\n"
+                "#request:\n"
+                "#  client_id: 0\n"
+                "#  timeout: 30.0\n"
+            )
     print(
-        f"wrote {keys_path} (usig={store.usig_spec}) and {cfg_path} "
-        f"(n={args.replicas}, f={f})",
+        f"wrote {keys_path} (usig={store.usig_spec}), {cfg_path} "
+        f"(n={args.replicas}, f={f}), and {peer_path}",
         file=sys.stderr,
     )
     return 0
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    path, explicit = peek_options_path(argv)
+    args = build_parser(load_peer_options(path, explicit)).parse_args(argv)
     if args.command == "run":
         return asyncio.run(_run_replica(args))
     if args.command == "request":

@@ -223,6 +223,76 @@ def ecdsa_verify(q: PointA, digest: bytes, sig: Tuple[int, int]) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Wider NIST curves — host path only.  The reference's ECDSA keyspec
+# accepts DER keys for P-224 through P-521 (reference
+# sample/authentication/keymanager.go:169-241); this build serves P-384 and
+# P-521 through OpenSSL with raw fixed-width encodings.  The TPU kernels
+# stay P-256-only (the hot path); these curves never touch the device.
+
+_NIST_CURVES: dict = {}
+if _HAVE_OSSL:
+    _NIST_CURVES = {
+        "p384": (_ossl_ec.SECP384R1(), _ossl_hashes.SHA384(), 48),
+        "p521": (_ossl_ec.SECP521R1(), _ossl_hashes.SHA512(), 66),
+    }
+
+
+def _nist_params(curve: str):
+    params = _NIST_CURVES.get(curve)
+    if params is None:
+        raise ValueError(
+            f"unsupported NIST curve {curve!r}"
+            + ("" if _HAVE_OSSL else " (cryptography/OpenSSL unavailable)")
+        )
+    return params
+
+
+def nist_scalar_bytes(curve: str) -> int:
+    """Fixed scalar/coordinate width in bytes for ``curve``."""
+    return _nist_params(curve)[2]
+
+
+def nist_keygen(curve: str) -> Tuple[bytes, bytes]:
+    """-> (private scalar bytes, public x||y bytes), fixed width."""
+    c, _, nb = _nist_params(curve)
+    nums = _ossl_ec.generate_private_key(c).private_numbers()
+    pub = nums.public_numbers
+    return (
+        nums.private_value.to_bytes(nb, "big"),
+        pub.x.to_bytes(nb, "big") + pub.y.to_bytes(nb, "big"),
+    )
+
+
+def nist_sign(curve: str, priv: bytes, msg: bytes) -> bytes:
+    """ECDSA over the curve's matched hash -> raw r||s (fixed width)."""
+    c, h, nb = _nist_params(curve)
+    key = _ossl_ec.derive_private_key(int.from_bytes(priv, "big"), c)
+    r, s = _decode_dss(key.sign(msg, _ossl_ec.ECDSA(h)))
+    return r.to_bytes(nb, "big") + s.to_bytes(nb, "big")
+
+
+def nist_verify(curve: str, pub: bytes, msg: bytes, sig: bytes) -> bool:
+    c, h, nb = _nist_params(curve)
+    if len(sig) != 2 * nb or len(pub) != 2 * nb:
+        return False
+    try:
+        key = _ossl_ec.EllipticCurvePublicNumbers(
+            int.from_bytes(pub[:nb], "big"),
+            int.from_bytes(pub[nb:], "big"),
+            c,
+        ).public_key()
+    except ValueError:
+        return False  # off-curve / out-of-range public key
+    r = int.from_bytes(sig[:nb], "big")
+    s = int.from_bytes(sig[nb:], "big")
+    try:
+        key.verify(_encode_dss(r, s), msg, _ossl_ec.ECDSA(h))
+        return True
+    except _InvalidSignature:
+        return False
+
+
+# ---------------------------------------------------------------------------
 # Ed25519 (RFC 8032). Used by the Ed25519 authenticator (BASELINE config[4]).
 
 ED_P = 2**255 - 19

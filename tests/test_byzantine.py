@@ -14,14 +14,20 @@ from minbft_tpu.messages.message import Commit, Prepare, Request
 from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
 
 
-async def _inject_peer_messages(stub, attacker_id: int, payloads) -> None:
+async def _inject_peer_messages(stub, attacker, payloads) -> None:
     """Open a peer stream to the stub's replica (as the reference's HELLO
-    handshake does) and pump crafted payloads into it."""
+    handshake does) and pump crafted payloads into it.  ``attacker`` is
+    the byzantine INSIDER replica whose stream this impersonates — the
+    HELLO must carry its genuine signature now that the handshake is
+    authenticated (an outsider without any replica key is refused at
+    HELLO; see test_handlers_unit.test_id_spoofing_hello_is_refused)."""
     handler = stub.peer_message_stream_handler()
     done = asyncio.Event()
 
     async def outgoing():
-        yield marshal(Hello(replica_id=attacker_id))
+        hello = Hello(replica_id=attacker.id)
+        attacker.handlers.sign_message(hello)
+        yield marshal(hello)
         for p in payloads:
             yield p
         # keep the stream open briefly so the payloads are consumed
@@ -69,7 +75,7 @@ def test_cluster_survives_forged_and_malformed_peer_messages():
             marshal(fake_req),                           # forged client sig via peer stream
         ]
         dropped_before = replicas[1].metrics.counters.get("messages_dropped", 0)
-        await _inject_peer_messages(stubs[1], 2, payloads)
+        await _inject_peer_messages(stubs[1], replicas[2], payloads)
 
         # give the drops a moment to be accounted
         for _ in range(100):
@@ -118,7 +124,7 @@ def test_replayed_commit_is_idempotent():
         ]
         assert commits
         handled_before = replicas[1].metrics.counters.get("messages_handled", 0)
-        await _inject_peer_messages(stubs[1], 2, [marshal(commits[0])] * 3)
+        await _inject_peer_messages(stubs[1], replicas[2], [marshal(commits[0])] * 3)
         # positive delivery signal: the replays were actually handled
         # (validated, then deduplicated by in-order UI capture) — without
         # this the test could pass vacuously if injection silently failed

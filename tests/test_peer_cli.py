@@ -9,6 +9,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 from minbft_tpu.sample.authentication import KeyStore
 from minbft_tpu.sample.config import load_config
 from minbft_tpu.sample.peer.cli import main
@@ -150,3 +152,73 @@ def test_metrics_interval_output_shape(tmp_path):
     for key in ("executed_per_sec", "execute_latency_p50_ms",
                 "execute_latency_p99_ms"):
         assert key in snap, snap
+
+
+def test_peer_options_file_layering(tmp_path, monkeypatch):
+    """Per-node peer.yaml (reference sample/peer/peer.yaml + root.go:54-82):
+    file values replace built-in defaults, PEER_* env overrides the file,
+    and flags override both."""
+    from minbft_tpu.sample.peer.cli import build_parser, load_peer_options
+
+    opt_file = tmp_path / "peer.yaml"
+    opt_file.write_text(
+        "keys: /etc/minbft/keys.yaml\n"
+        "log_level: debug\n"
+        "run:\n"
+        "  batch: 128\n"
+        "  metrics_interval: 5\n"
+        "request:\n"
+        "  timeout: 7.5\n"
+    )
+    opts = load_peer_options(str(opt_file), explicit=True)
+
+    args = build_parser(opts).parse_args(["run", "0"])
+    assert args.keys == "/etc/minbft/keys.yaml"
+    assert args.log_level == "debug"
+    assert args.batch == 128
+    assert args.metrics_interval == 5.0  # coerced to the option's type
+
+    # env overrides the file; flags override both
+    monkeypatch.setenv("PEER_BATCH", "64")
+    args = build_parser(opts).parse_args(["run", "0"])
+    assert args.batch == 64
+    args = build_parser(opts).parse_args(["--keys", "k2.yaml", "run", "0"])
+    assert args.keys == "k2.yaml"
+
+    args = build_parser(opts).parse_args(["request", "op"])
+    assert args.timeout == 7.5
+
+
+def test_peer_options_file_rejects_unknowns(tmp_path):
+    from minbft_tpu.sample.peer.cli import load_peer_options
+
+    bad = tmp_path / "peer.yaml"
+    bad.write_text("batchsize: 10\n")  # typo'd key must fail loudly
+    with pytest.raises(SystemExit, match="unknown option"):
+        load_peer_options(str(bad), explicit=True)
+    bad.write_text("run:\n  batsch: 10\n")
+    with pytest.raises(SystemExit, match="unknown option"):
+        load_peer_options(str(bad), explicit=True)
+    # non-scalar values for scalar options fail loudly too (str() would
+    # happily stringify a list into a bogus path)
+    bad.write_text("keys: [a.yaml, b.yaml]\n")
+    with pytest.raises(SystemExit, match="must be a scalar"):
+        load_peer_options(str(bad), explicit=True)
+    bad.write_text("run:\n  batch: {x: 1}\n")
+    with pytest.raises(SystemExit, match="must be a scalar"):
+        load_peer_options(str(bad), explicit=True)
+    with pytest.raises(SystemExit, match="not found"):
+        load_peer_options(str(tmp_path / "absent.yaml"), explicit=True)
+    # a missing DEFAULT path is not an error — no file, no layering
+    assert load_peer_options(str(tmp_path / "absent.yaml"), explicit=False) == {}
+
+
+def test_peer_options_flag_end_to_end(tmp_path):
+    """The --options flag reaches main(): a bad file fails loudly even
+    though the subcommand is valid."""
+    from minbft_tpu.sample.peer.cli import main as cli_main
+
+    bad = tmp_path / "opts.yaml"
+    bad.write_text("nonsense: 1\n")
+    with pytest.raises(SystemExit, match="unknown option"):
+        cli_main(["--options", str(bad), "selftest"])
