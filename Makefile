@@ -1,0 +1,49 @@
+# Top-level build/check entry points (reference Makefile:82-83 `check` =
+# build + usig-check + `go test -short -race ./...`; lint = golangci-lint).
+#
+#   make native      build the native C++ USIG module (+ its C++ unit test)
+#   make lint        byte-compile every source file (the no-new-deps linter
+#                    tier: catches syntax/undefined-name-level rot) + a
+#                    pyflakes pass when available
+#   make fast        native + lint + the unit tier of the test suite (<2min)
+#   make check       native + lint + the FULL test suite (~9min, what CI runs)
+#   make bench       the driver's bench entry point (real TPU)
+#
+# Tests force the CPU backend with 8 virtual devices via tests/conftest.py.
+
+PY ?= python
+
+.PHONY: native lint fast check test bench clean
+
+native:
+	$(MAKE) -C minbft_tpu/native
+
+# The image has no dedicated Python linter baked in; compileall is the
+# always-available floor, pyflakes layers on when present.
+lint:
+	$(PY) -m compileall -q minbft_tpu tests bench.py __graft_entry__.py
+	@$(PY) -c "import pyflakes" 2>/dev/null \
+	    && $(PY) -m pyflakes minbft_tpu bench.py __graft_entry__.py \
+	    || echo "pyflakes not installed; compileall-only lint"
+
+# Unit tier: everything except the multi-process / deploy / soak suites —
+# the reference's `go test -short` equivalent.
+fast: native lint
+	$(PY) -m pytest tests/ -x -q \
+	    --ignore=tests/test_process_cluster.py \
+	    --ignore=tests/test_peer_cli.py \
+	    --ignore=tests/test_deploy.py \
+	    --ignore=tests/test_soak_bounded.py \
+	    --ignore=tests/test_stress_concurrent.py
+
+check: native lint
+	$(PY) -m pytest tests/ -q
+
+test: check
+
+bench:
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C minbft_tpu/native clean 2>/dev/null || true
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -32,7 +32,16 @@ import time
 from typing import AsyncIterator, Dict, Optional
 
 from .. import api
-from ..messages import Reply, Request, authen_bytes, marshal, unmarshal
+from ..messages import (
+    CodecError,
+    Reply,
+    Request,
+    authen_bytes,
+    drain_multi,
+    marshal,
+    split_multi,
+    unmarshal,
+)
 
 
 class _PendingRequest:
@@ -111,12 +120,21 @@ class Client:
         self, replica_id: int, handler: api.MessageStreamHandler, q: asyncio.Queue
     ) -> None:
         async def outgoing() -> AsyncIterator[bytes]:
+            # Coalesce a pipelined burst of requests into one transport
+            # frame — per-frame gRPC/asyncio cost dominates on small hosts
+            # (see core.message_handling's pump coalescing).
             while True:
-                yield await q.get()
+                data, _ = drain_multi(await q.get(), q)
+                yield data
 
         try:
             async for data in handler.handle_message_stream(outgoing()):
-                await self._handle_reply(replica_id, data)
+                try:
+                    frames = split_multi(data)
+                except CodecError:
+                    continue
+                for fr in frames:
+                    await self._handle_reply(replica_id, fr)
         except asyncio.CancelledError:
             raise
         except Exception:

@@ -5,7 +5,14 @@ messages/authen.go, messages/protobuf/) — see module docstrings.
 """
 
 from .authen import authen_bytes, authen_digest
-from .codec import CodecError, marshal, unmarshal
+from .codec import (
+    CodecError,
+    drain_multi,
+    marshal,
+    pack_multi,
+    split_multi,
+    unmarshal,
+)
 from .message import (
     CERTIFIED_MESSAGES,
     CLIENT_MESSAGES,

@@ -53,6 +53,12 @@ _TAG_CHECKPOINT = 0x09
 _TAG_LOG_BASE = 0x0A
 _TAG_SNAPSHOT_REQ = 0x0B
 _TAG_SNAPSHOT_RESP = 0x0C
+# Transport-level container: several messages coalesced into ONE stream
+# frame (amortizes the per-frame gRPC/asyncio cost, which dominates the
+# multi-process deployment's throughput on small hosts).  Deliberately far
+# from the message tags — a multi frame is framing, not a message, and
+# never nests.
+_TAG_MULTI = 0xF0
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
@@ -477,3 +483,66 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
             off,
         )
     raise CodecError(f"unknown message tag {tag:#x}")
+
+
+def pack_multi(frames) -> bytes:
+    """Coalesce several wire frames into one transport frame (len==1 stays
+    bare — the container only exists to amortize per-frame stream costs)."""
+    if len(frames) == 1:
+        return frames[0]
+    out = [bytes([_TAG_MULTI]), _pack_u32(len(frames))]
+    for fr in frames:
+        out.append(_pack_u32(len(fr)))
+        out.append(fr)
+    return b"".join(out)
+
+
+def split_multi(data: bytes):
+    """Inverse of :func:`pack_multi`: a bare frame comes back as [data];
+    a container is split into its messages (malformed containers raise
+    CodecError like any bad wire bytes)."""
+    if not data or data[0] != _TAG_MULTI:
+        return [data]
+    n, off = _read_u32(data, 1)
+    if n > 65536:
+        raise CodecError(f"multi frame claims {n} messages")
+    frames = []
+    for _ in range(n):
+        ln, off = _read_u32(data, off)
+        if off + ln > len(data):
+            raise CodecError("truncated multi frame")
+        frames.append(data[off : off + ln])
+        off += ln
+    if off != len(data):
+        raise CodecError("trailing bytes in multi frame")
+    return frames
+
+
+# Coalescing bounds shared by every stream pump: one frame can neither
+# starve its stream (message count) nor trip gRPC's 4MB default (bytes).
+MULTI_MAX_MSGS = 128
+MULTI_MAX_BYTES = 256 * 1024
+
+
+def drain_multi(first: bytes, queue, encode=None, stop=None):
+    """Coalesce ``first`` plus whatever is ALREADY queued into one packed
+    frame -> (frame, saw_stop).  ``encode`` maps queue items to wire bytes
+    (identity by default); ``stop`` is an optional sentinel that ends the
+    drain and is reported instead of being packed.  Never blocks — only
+    items reachable via ``get_nowait`` ride along."""
+    frames = [first]
+    total = len(first)
+    saw_stop = False
+    while (
+        len(frames) < MULTI_MAX_MSGS
+        and total < MULTI_MAX_BYTES
+        and not queue.empty()
+    ):
+        item = queue.get_nowait()
+        if stop is not None and item is stop:
+            saw_stop = True
+            break
+        fr = encode(item) if encode is not None else item
+        frames.append(fr)
+        total += len(fr)
+    return pack_multi(frames), saw_stop

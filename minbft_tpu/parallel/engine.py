@@ -117,6 +117,17 @@ class _SchemeQueue:
         self._probing = False
 
     def submit(self, item) -> "asyncio.Future | _Resolved":
+        if not self.engine.dedup:
+            # Measurement mode (round-4 verdict weak #1): every submission
+            # occupies its own device lane — no memo, no in-flight
+            # coalescing — so device traffic equals the protocol's logical
+            # verification demand.  Duplicate items in one batch resolve
+            # together on the first lane's pop (same pure-function verdict).
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+            self._inflight_futs.setdefault(item, []).append(fut)
+            self.pending.append((item, fut))
+            return self._schedule_flush(fut)
         verdict = self._memo.get(item)
         if verdict is None:
             verdict = self._neg_memo.get(item)
@@ -139,6 +150,10 @@ class _SchemeQueue:
             return fut
         self._inflight_futs[item] = [fut]
         self.pending.append((item, fut))
+        return self._schedule_flush(fut)
+
+    def _schedule_flush(self, fut: asyncio.Future) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
         if len(self.pending) >= self.engine.max_batch:
             self._flush_now()
         elif self.inflight == 0 and self._flush_handle is None:
@@ -186,12 +201,14 @@ class _SchemeQueue:
         st.batches += 1
         st.max_batch_seen = max(st.max_batch_seen, len(batch))
         st.device_time_s += dt
+        dedup = self.engine.dedup
         for (it, _), ok in zip(batch, results):
             ok = bool(ok)
-            # Pure function: verdicts (both ways) are stable — but they
-            # age out of segregated LRUs so garbage cannot evict good.
-            memo = self._memo if ok else self._neg_memo
-            memo[it] = ok
+            if dedup:
+                # Pure function: verdicts (both ways) are stable — but they
+                # age out of segregated LRUs so garbage cannot evict good.
+                memo = self._memo if ok else self._neg_memo
+                memo[it] = ok
             for fut in self._inflight_futs.pop(it, ()):
                 if not fut.done():
                     fut.set_result(ok)
@@ -313,7 +330,13 @@ class BatchVerifier:
         max_inflight: int = 2,
         mesh=None,
         dispatch_timeout: float = 90.0,
+        dedup: bool = True,
     ):
+        # dedup=False is a MEASUREMENT mode: every logical verification
+        # occupies a device lane (no memo, no in-flight coalescing), so
+        # reported device verifies/s equals protocol demand — see
+        # _SchemeQueue.submit.  Production keeps dedup on.
+        self.dedup = dedup
         # Liveness net for remote-attached chips: a device dispatch that
         # exceeds this many seconds (generous — cold bucket compiles take
         # ~40s) is abandoned and its items re-verified on host; see

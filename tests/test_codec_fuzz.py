@@ -63,3 +63,28 @@ def test_roundtrip_is_canonical_for_all_kinds():
     for m in _sample_messages():
         data = marshal(m)
         assert marshal(unmarshal(data)) == data
+
+
+def test_multi_frame_roundtrip_and_malformed():
+    """Transport frame coalescing: pack/split round-trips, bare frames
+    pass through, and malformed containers raise CodecError instead of
+    crashing the stream."""
+    import pytest
+
+    from minbft_tpu.messages import CodecError, pack_multi, split_multi
+
+    frames = [b"\x02aaa", b"\x04b", b"\x05" + b"c" * 100]
+    packed = pack_multi(frames)
+    assert split_multi(packed) == frames
+    # single frame stays bare (no container overhead)
+    assert pack_multi([b"\x02xyz"]) == b"\x02xyz"
+    assert split_multi(b"\x02xyz") == [b"\x02xyz"]
+
+    for bad in (
+        packed[:-2],                      # truncated payload
+        packed[:5],                       # truncated length
+        packed + b"!",                    # trailing bytes
+        b"\xf0\xff\xff\xff\xff",          # absurd count
+    ):
+        with pytest.raises(CodecError):
+            split_multi(bad)

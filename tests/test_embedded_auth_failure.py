@@ -66,7 +66,11 @@ def test_backup_demands_view_change_on_ui_valid_bad_request():
             done = asyncio.Event()
 
             async def outgoing():
-                yield marshal(Hello(replica_id=0))
+                # the handshake is authenticated now: sign as the real
+                # primary whose stream this impersonates
+                hello = Hello(replica_id=0)
+                primary.sign_message(hello)
+                yield marshal(hello)
                 yield marshal(prep)
                 try:
                     await asyncio.wait_for(done.wait(), 1.0)

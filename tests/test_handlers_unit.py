@@ -25,6 +25,7 @@ from minbft_tpu.messages import (
     ReqViewChange,
     Request,
     marshal,
+    split_multi,
     unmarshal,
 )
 from minbft_tpu.sample.config import SimpleConfiger
@@ -241,8 +242,10 @@ def test_hello_handler_replays_broadcast_and_unicast():
         handler = PeerStreamHandler(h)
         out = handler.handle_message_stream(incoming())
         got = []
-        for _ in range(2):
-            got.append(unmarshal(await asyncio.wait_for(out.__anext__(), 5)))
+        while len(got) < 2:
+            # frames may arrive coalesced (pack_multi) — split first
+            data = await asyncio.wait_for(out.__anext__(), 5)
+            got.extend(unmarshal(fr) for fr in split_multi(data))
         await out.aclose()
         return p, forwarded, got
 
