@@ -19,12 +19,16 @@ native:
 	$(MAKE) -C minbft_tpu/native
 
 # The image has no dedicated Python linter baked in; compileall is the
-# always-available floor, pyflakes layers on when present.
+# always-available floor, pyflakes layers on when present.  The presence
+# check is separate from the run so a real pyflakes FAILURE fails the
+# target (an `a && b || c` chain would swallow it).
 lint:
 	$(PY) -m compileall -q minbft_tpu tests bench.py __graft_entry__.py
-	@$(PY) -c "import pyflakes" 2>/dev/null \
-	    && $(PY) -m pyflakes minbft_tpu bench.py __graft_entry__.py \
-	    || echo "pyflakes not installed; compileall-only lint"
+	@if $(PY) -c "import pyflakes" 2>/dev/null; then \
+	    $(PY) -m pyflakes minbft_tpu bench.py __graft_entry__.py; \
+	else \
+	    echo "pyflakes not installed; compileall-only lint"; \
+	fi
 
 # Unit tier: everything except the multi-process / deploy / soak suites —
 # the reference's `go test -short` equivalent.
