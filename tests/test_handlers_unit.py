@@ -10,6 +10,7 @@ view v+1.
 """
 
 import asyncio
+import contextlib
 
 import pytest
 
@@ -619,6 +620,67 @@ def test_id_spoofing_hello_is_refused():
         got = await asyncio.wait_for(out.__anext__(), 5)
         assert unmarshal(got) == _req()
         await out.aclose()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_malformed_multi_frame_is_dropped_not_fatal():
+    """A byzantine peer sending a corrupt coalesced container must cost
+    only that frame: it counts as a drop and the stream keeps processing
+    later (well-formed) frames."""
+
+    async def scenario():
+        import struct
+
+        from minbft_tpu.messages import pack_multi
+
+        h = _handlers(replica_id=0)
+        good_req = _req(client_id=1, seq=1)
+
+        hello = Hello(replica_id=1)
+        hello.signature = b"sig"
+
+        # A container corrupt at the CONTAINER level: the first subframe's
+        # length prefix claims far more bytes than exist, so the drop can
+        # only come from split_multi's truncation check — an intact first
+        # subframe would let per-message unmarshal failures satisfy the
+        # assert vacuously.
+        packed_garbage = (
+            b"\xf0" + struct.pack(">I", 2) + struct.pack(">I", 10**8)
+        )
+
+        async def incoming():
+            yield marshal(hello)
+            yield packed_garbage
+            # a proper coalesced frame still lands after the bad one
+            yield pack_multi([marshal(good_req), marshal(good_req)])
+            await asyncio.sleep(30)
+
+        handler = PeerStreamHandler(h)
+        out = handler.handle_message_stream(incoming())
+        stream_task = asyncio.ensure_future(out.__anext__())
+        for _ in range(100):
+            if h.metrics.counters.get("messages_dropped", 0) >= 1 and (
+                h.metrics.counters.get("prepares_sent", 0) >= 1
+            ):
+                break
+            await asyncio.sleep(0.02)
+        # deliver the cancellation BEFORE aclose — closing a generator
+        # whose __anext__ is still suspended raises RuntimeError and would
+        # mask the diagnostic asserts below on exactly the failure path
+        stream_task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await stream_task
+        await out.aclose()
+        assert h.metrics.counters.get("messages_dropped", 0) >= 1, (
+            "malformed container not counted as a drop"
+        )
+        # the later well-formed frame was processed: this replica (the
+        # view-0 primary) proposed the embedded request
+        assert h.metrics.counters.get("prepares_sent", 0) >= 1, (
+            "stream did not survive the malformed container"
+        )
         return True
 
     assert asyncio.run(scenario())

@@ -238,3 +238,81 @@ def test_tcp_transport_cluster_commits(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def test_tcp_primary_crash_recovers(tmp_path):
+    """View change over the native TCP transport: kill the view-0 primary
+    process and a later request commits in view >= 1 (the transport's
+    reconnect/stream semantics must carry the full transition)."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        CONSENSUS_TIMEOUT_REQUEST="2s",
+        CONSENSUS_TIMEOUT_PREPARE="1s",
+        CONSENSUS_TIMEOUT_VIEWCHANGE="5s",
+    )
+    d = str(tmp_path)
+    base_port = _free_base_port(3)
+
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "3", "-d", d, "--base-port", str(base_port), "--usig", "SOFT_ECDSA"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = []
+    logs = []
+    try:
+        for i in range(3):
+            log = open(f"{d}/replica{i}.log", "wb")
+            logs.append(log)
+            replicas.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "minbft_tpu.sample.peer",
+                     "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+                     "--transport", "tcp", "run", str(i), "--no-batch"],
+                    env=env, stdout=subprocess.DEVNULL, stderr=log,
+                )
+            )
+        assert _wait_ports([base_port + i for i in range(3)]), "replicas never bound"
+
+        req = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "--transport", "tcp", "request", "before-crash", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert req.returncode == 0, req.stderr
+
+        replicas[0].kill()  # the view-0 primary
+        replicas[0].wait(timeout=10)
+
+        req2 = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "--transport", "tcp", "request", "after-crash", "--timeout", "150"],
+            env=env, capture_output=True, text=True, timeout=200,
+        )
+        assert req2.returncode == 0, (
+            req2.stderr
+            + "".join(
+                open(f"{d}/replica{i}.log", "rb").read().decode(errors="replace")[-1500:]
+                for i in (1, 2)
+            )
+        )
+        assert any(
+            b"entered view" in open(f"{d}/replica{i}.log", "rb").read()
+            for i in (1, 2)
+        ), "no survivor logged a completed view change"
+    finally:
+        for p in replicas:
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

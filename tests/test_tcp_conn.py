@@ -1,0 +1,158 @@
+"""Native TCP transport unit tests (sample/conn/tcp): frame round-trips,
+chat-kind routing, the frame-length cap, late-binding dial retry, and
+server stop with live connections (the 3.12 wait_closed regression)."""
+
+import asyncio
+import struct
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.sample.conn.tcp import (
+    CLIENT_KIND,
+    MAX_FRAME,
+    TcpReplicaConnector,
+    TcpReplicaServer,
+)
+
+
+class _EchoHandler(api.MessageStreamHandler):
+    def __init__(self, tag: bytes):
+        self._tag = tag
+
+    async def handle_message_stream(self, in_stream):
+        async for data in in_stream:
+            yield self._tag + data
+
+
+class _EchoConn(api.ConnectionHandler):
+    def peer_message_stream_handler(self):
+        return _EchoHandler(b"P:")
+
+    def client_message_stream_handler(self):
+        return _EchoHandler(b"C:")
+
+
+async def _drive(handler, frames, n_expect):
+    sent = asyncio.Event()
+
+    async def outgoing():
+        for fr in frames:
+            yield fr
+        sent.set()
+        await asyncio.sleep(30)  # keep the stream open
+
+    out = handler.handle_message_stream(outgoing())
+    got = []
+    try:
+        while len(got) < n_expect:
+            got.append(await asyncio.wait_for(out.__anext__(), 10))
+    finally:
+        await out.aclose()
+    return got
+
+
+def test_round_trip_and_kind_routing():
+    async def scenario():
+        server = TcpReplicaServer(_EchoConn())
+        addr = await server.start("127.0.0.1:0")
+        try:
+            for kind, tag in (("peer", b"P:"), ("client", b"C:")):
+                conn = TcpReplicaConnector(kind)
+                conn.connect_replica(0, addr)
+                h = conn.replica_message_stream_handler(0)
+                frames = [b"alpha", b"x" * 70_000, b""]
+                got = await _drive(h, frames, len(frames))
+                assert got == [tag + f for f in frames]
+        finally:
+            await server.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_oversized_frame_closes_connection_only():
+    """A length prefix past MAX_FRAME is a hostile/corrupt stream: that
+    connection dies; the server keeps serving others."""
+
+    async def scenario():
+        server = TcpReplicaServer(_EchoConn())
+        addr = await server.start("127.0.0.1:0")
+        host, port = addr.rsplit(":", 1)
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write(CLIENT_KIND + struct.pack(">I", MAX_FRAME + 1))
+            await writer.drain()
+            # server closes on the bogus prefix: EOF on our side
+            assert await asyncio.wait_for(reader.read(), 10) == b""
+            writer.close()
+
+            # a well-behaved connection still works afterwards
+            conn = TcpReplicaConnector("client")
+            conn.connect_replica(0, addr)
+            got = await _drive(
+                conn.replica_message_stream_handler(0), [b"ok"], 1
+            )
+            assert got == [b"C:ok"]
+        finally:
+            await server.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_dial_retries_until_server_binds():
+    """wait_for_ready semantics: the dialer retries while the server is
+    still coming up (clusters start in any order)."""
+
+    async def scenario():
+        from minbft_tpu.utils.netports import free_base_port
+
+        port = free_base_port(1)
+        conn = TcpReplicaConnector("peer", dial_timeout=30.0)
+        conn.connect_replica(0, f"127.0.0.1:{port}")
+        h = conn.replica_message_stream_handler(0)
+
+        async def late_server():
+            await asyncio.sleep(0.5)
+            server = TcpReplicaServer(_EchoConn())
+            await server.start(f"127.0.0.1:{port}")
+            return server
+
+        server_task = asyncio.ensure_future(late_server())
+        got = await _drive(h, [b"late"], 1)
+        assert got == [b"P:late"]
+        await (await server_task).stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_server_stop_with_live_connections_returns():
+    """Regression: in 3.12 Server.wait_closed() waits for connection
+    handlers to finish, and ours run until their stream ends — stop()
+    must cancel them or it hangs forever."""
+
+    async def scenario():
+        server = TcpReplicaServer(_EchoConn())
+        addr = await server.start("127.0.0.1:0")
+        conn = TcpReplicaConnector("peer")
+        conn.connect_replica(0, addr)
+        h = conn.replica_message_stream_handler(0)
+        got = await _drive(h, [b"live"], 1)  # stream opened and exercised
+        assert got == [b"P:live"]
+        # another stream left OPEN while the server stops
+        open_stream = h.handle_message_stream(_forever())
+        first = await asyncio.wait_for(open_stream.__anext__(), 10)
+        assert first == b"P:first"  # the connection is live right now
+        await asyncio.wait_for(server.stop(), 10)  # must not hang
+        # the dropped connection ends the stream instead of wedging it
+        with pytest.raises(StopAsyncIteration):
+            await asyncio.wait_for(open_stream.__anext__(), 10)
+        return True
+
+    async def _forever():
+        yield b"first"
+        await asyncio.sleep(30)
+
+    assert asyncio.run(scenario())
