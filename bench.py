@@ -9,9 +9,17 @@ committed-requests/sec figure from an in-process n=7 f=3 cluster whose
 COMMIT-phase verification runs through the batching engine.
 
 Environment knobs:
-  MINBFT_BENCH_BATCH      ECDSA batch size (default 16384)
-  MINBFT_BENCH_REQUESTS   end-to-end request count (default 10000)
-  MINBFT_BENCH_SKIP_E2E   set to skip the cluster phase
+  MINBFT_BENCH_BATCH        ECDSA batch size (default 32768)
+  MINBFT_BENCH_REQUESTS     end-to-end request count (default 10000)
+  MINBFT_BENCH_RUNS         timed runs per e2e config (default 3)
+  MINBFT_BENCH_DEPTH        in-process client pipeline depth (default 24)
+  MINBFT_BENCH_MP_DEPTH / _MPTCP_DEPTH / _MP_REQUESTS / _MP_BATCHSIZE
+                            multi-process phase operating point
+  MINBFT_BENCH_SLO_P50_MS   latency target for the *_at_p50_* runs (500)
+  MINBFT_BENCH_SKIP_E2E / _SKIP_MP / _SKIP_NODEDUP / _SKIP_SLO /
+  _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519   phase gates
+  MINBFT_BENCH_CFG{1,2,4,5}_REQUESTS, _MAC_REQUESTS, _ISO_REQUESTS,
+  _NODEDUP_REQUESTS, _NODEDUPREF_REQUESTS      per-config run lengths
 """
 
 import asyncio
