@@ -966,6 +966,21 @@ class Handlers:
         self._adopt_cert(lb.cert)
         if self.checkpoint_emitter.count < cp.count:
             await self._request_state(lb.cert, first_source=lb.replica_id)
+        # State-transfer TOFU: a late joiner never sees this peer's
+        # counter-1 UI (the certificate proves that history is covered
+        # and it was truncated) — permit first-contact epoch capture from
+        # the first valid UI above the certified base, or the joiner
+        # installs the snapshot and then rejects every live message.
+        # Only while actually BEHIND the certificate: a caught-up replica
+        # saw the history (or holds captured epochs), and a standing
+        # floor would widen the stale-epoch re-pin window the counter-1
+        # rule narrows (see reset_usig_epoch).
+        if self.checkpoint_emitter.count < cp.count:
+            allow = getattr(
+                self.authenticator, "allow_epoch_capture_from", None
+            )
+            if allow is not None:
+                allow(lb.replica_id, lb.base + 1)
         await self.peer_states.peer(lb.replica_id).fast_forward(lb.base + 1)
         return True
 

@@ -182,6 +182,10 @@ class SampleAuthenticator(api.Authenticator):
         # higher-counter UIs wait instead of spuriously failing.
         self._usig_epochs: Dict[int, bytes] = {}
         self._usig_epoch_pending: Dict[int, "asyncio.Future"] = {}
+        # Per-peer minimum counters from which first-contact epoch capture
+        # is allowed WITHOUT counter 1 (state-transfer joins; see
+        # allow_epoch_capture_from).
+        self._epoch_capture_floor: Dict[int, int] = {}
         # Self-anchor: our own epoch needs no first-contact capture — we
         # ARE the trusted source.  Without this, a replica that becomes
         # primary after a view change cannot verify its own UIs embedded
@@ -256,8 +260,34 @@ class SampleAuthenticator(api.Authenticator):
         """Forget the captured epoch for a peer so its next counter-1 UI
         re-captures — the operator re-bootstrap hook for accepting a
         restarted peer's fresh epoch (the reference leaves this to "some
-        bootstrapping procedure", crypto.go:219-225)."""
+        bootstrapping procedure", crypto.go:219-225).
+
+        Any state-transfer capture floor is dropped too: a restarted peer
+        signs from counter 1 again, and a surviving floor would let a
+        delayed PRE-restart message (counter >= floor) re-pin the stale
+        epoch and undo this reset — the exact race the counter-1 rule
+        exists to narrow."""
         self._usig_epochs.pop(peer_id, None)
+        self._epoch_capture_floor.pop(peer_id, None)
+
+    def allow_epoch_capture_from(self, peer_id: int, counter: int) -> None:
+        """Permit first-contact epoch capture from a UI at counter >=
+        ``counter`` for ``peer_id``.
+
+        A replica that joins late via state transfer NEVER sees any
+        peer's counter-1 UI — that history is provably covered by an
+        f+1-certified checkpoint and was truncated — so the reference's
+        counter-1-only TOFU rule would leave it unable to establish any
+        epoch and deaf to all live traffic.  The core calls this when it
+        validates a peer's LOG-BASE announcement (the f+1 certificate
+        proves counters <= base hold no live evidence): capturing from
+        the first valid UI above the certified base trusts exactly what
+        counter-1 capture trusts — the first contact signed by the
+        anchored key (reference crypto.go:204-218's stated assumption),
+        no more."""
+        cur = self._epoch_capture_floor.get(peer_id)
+        if cur is None or counter < cur:
+            self._epoch_capture_floor[peer_id] = counter
 
     def _resolve_usig_id(self, peer_id: int, ui: UI) -> Tuple[bytes, bool]:
         """Resolve the effective usig_id (epoch || key material) for a
@@ -278,11 +308,16 @@ class SampleAuthenticator(api.Authenticator):
             return epoch + anchor, False
         # Capture the epoch from the first valid UI — which must carry
         # counter 1 (reference crypto.go:220-226: epoch is taken from
-        # the cert only when none is captured AND ui.Counter == 1).
-        if ui.counter != 1:
+        # the cert only when none is captured AND ui.Counter == 1), OR
+        # sit at/above a checkpoint-certified log base this replica
+        # adopted (state-transfer join: counter-1 history is truncated —
+        # see allow_epoch_capture_from).
+        floor = self._epoch_capture_floor.get(peer_id)
+        if ui.counter != 1 and (floor is None or ui.counter < floor):
             raise api.AuthenticationError(
                 f"no captured epoch for replica {peer_id} and UI counter "
                 f"{ui.counter} != 1"
+                + (f" (state-transfer capture floor: {floor})" if floor else "")
             )
         if len(ui.cert) < _EPOCH_LEN:
             raise api.AuthenticationError("malformed UI certificate")

@@ -229,6 +229,12 @@ class MacAuthenticator(api.Authenticator):
         if self._inner is not None:
             self._inner.reset_usig_epoch(peer_id)
 
+    def allow_epoch_capture_from(self, peer_id: int, counter: int) -> None:
+        """State-transfer TOFU floor (see SampleAuthenticator): forwarded
+        to the inner USIG authenticator."""
+        if self._inner is not None:
+            self._inner.allow_epoch_capture_from(peer_id, counter)
+
 
 def new_test_mac_authenticators(
     n: int,

@@ -182,3 +182,93 @@ def test_native_restart_fresh_epoch():
     assert usig_key_anchor(u1) == usig_key_anchor(u2)
     assert u1.epoch != u2.epoch
     assert u2.create_ui(b"x").counter == 1
+
+
+def test_state_transfer_tofu_floor_allows_capture_above_base():
+    """A late joiner whose history is truncated never sees counter-1 UIs;
+    after validating a peer's LOG-BASE the core installs an epoch-capture
+    floor, and the first valid UI at/above it establishes the epoch —
+    below the floor (and above counter 1) stays rejected."""
+    store = generate_testnet_keys(2, usig_spec="SOFT_ECDSA")
+    signer = store.replica_authenticator(0)
+    verifier = store.replica_authenticator(1)
+    tags = [
+        signer.generate_message_authen_tag(ROLE, b"m%d" % c)
+        for c in range(1, 8)
+    ]  # counters 1..7
+
+    # floor at counter 5 (base 4 truncated away)
+    verifier.allow_epoch_capture_from(0, 5)
+    verifier.tofu_capture_timeout = 0.05
+    # counter 3 is neither 1 nor >= floor: no capture
+    _expect_reject(verifier, 0, b"m3", tags[2])
+    # counter 6 is above the floor: captures the epoch...
+    _verify(verifier, 0, b"m6", tags[5])
+    # ...after which everything verifies normally, below the floor too
+    _verify(verifier, 0, b"m3", tags[2])
+    _verify(verifier, 0, b"m7", tags[6])
+
+
+def test_tofu_floor_keeps_rejecting_wrong_epoch():
+    """The floor relaxes WHICH counter may establish first contact, not
+    the anchor check: a UI signed under a different key (or a stale
+    epoch after capture) still fails."""
+    store = generate_testnet_keys(2, usig_spec="SOFT_ECDSA")
+    old_signer = store.replica_authenticator(0)
+    old_tag = old_signer.generate_message_authen_tag(ROLE, b"z")
+    for _ in range(5):
+        old_signer.generate_message_authen_tag(ROLE, b"pad")
+
+    # the peer restarted: fresh epoch, same key
+    new_signer_usig = store.make_usig(0)
+    from minbft_tpu.sample.authentication.authenticator import (
+        SampleAuthenticator,
+    )
+    from minbft_tpu.sample.authentication.keystore import usig_key_anchor
+
+    new_signer = SampleAuthenticator(
+        usig=new_signer_usig, usig_ids={0: usig_key_anchor(new_signer_usig)}
+    )
+    tags = [
+        new_signer.generate_message_authen_tag(ROLE, b"n%d" % c)
+        for c in range(1, 8)
+    ]
+
+    verifier = store.replica_authenticator(1)
+    verifier.tofu_capture_timeout = 0.05
+    verifier.allow_epoch_capture_from(0, 5)
+    _verify(verifier, 0, b"n6", tags[5])  # captures the NEW epoch
+    # the old epoch's counter-1 UI no longer passes
+    _expect_reject(verifier, 0, b"z", old_tag)
+
+
+def test_reset_usig_epoch_drops_capture_floor():
+    """Operator re-bootstrap must also drop the state-transfer floor: a
+    delayed PRE-restart message (counter >= floor) arriving after the
+    reset must not re-pin the stale epoch — only the restarted peer's
+    counter-1 UI re-captures."""
+    store = generate_testnet_keys(2, usig_spec="SOFT_ECDSA")
+    old_signer = store.replica_authenticator(0)
+    old_tags = [
+        old_signer.generate_message_authen_tag(ROLE, b"o%d" % c)
+        for c in range(1, 8)
+    ]
+    verifier = store.replica_authenticator(1)
+    verifier.tofu_capture_timeout = 0.05
+    verifier.allow_epoch_capture_from(0, 5)
+    _verify(verifier, 0, b"o6", old_tags[5])  # epoch captured via floor
+
+    from minbft_tpu.sample.authentication.authenticator import (
+        SampleAuthenticator,
+    )
+    from minbft_tpu.sample.authentication.keystore import usig_key_anchor
+
+    # peer restarts; operator re-bootstraps the verifier
+    verifier.reset_usig_epoch(0)
+    # a delayed pre-restart message above the old floor must NOT re-pin
+    _expect_reject(verifier, 0, b"o7", old_tags[6])
+
+    u = store.make_usig(0)
+    new_signer = SampleAuthenticator(usig=u, usig_ids={0: usig_key_anchor(u)})
+    t1 = new_signer.generate_message_authen_tag(ROLE, b"n1")
+    _verify(verifier, 0, b"n1", t1)  # fresh counter-1 re-captures
