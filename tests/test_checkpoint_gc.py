@@ -136,7 +136,14 @@ def test_wiped_replica_joins_via_state_transfer():
             n=n, f=f, checkpoint_period=10,
             timeout_request=60.0, timeout_prepare=30.0,
         )
-        r_auths, c_auths = new_test_authenticators(n, n_clients=1, usig_kind="hmac")
+        # TOFU anchors, not pinned IDs: a deployed keystore captures peer
+        # epochs trust-on-first-use, and a late joiner whose peers
+        # truncated history can only establish them through the
+        # LOG-BASE-installed capture floor — the round-5 state-transfer
+        # deadlock this test must keep pinned (pinned IDs masked it).
+        r_auths, c_auths = new_test_authenticators(
+            n, n_clients=1, usig_kind="hmac", tofu_anchors=True
+        )
         stubs = make_testnet_stubs(n)
         ledgers = [SimpleLedger() for _ in range(n)]
         replicas = []
