@@ -28,10 +28,12 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import time
 from typing import AsyncIterator, Dict, Optional
 
 from .. import api
+from ..utils.backoff import ReconnectBackoff
 from ..messages import (
     CodecError,
     Reply,
@@ -43,9 +45,20 @@ from ..messages import (
     unmarshal,
 )
 
+# Consecutive reply-handling failures on one stream before it is torn down
+# for a backoff redial (see _run_connection's poison-frame guard).
+_MAX_CONSECUTIVE_REPLY_ERRORS = 10
+
 
 class _PendingRequest:
-    __slots__ = ("seq", "f", "replies_by_replica", "count_by_digest", "result")
+    __slots__ = (
+        "seq",
+        "f",
+        "replies_by_replica",
+        "count_by_digest",
+        "result",
+        "data",
+    )
 
     def __init__(self, seq: int, f: int, loop: asyncio.AbstractEventLoop):
         self.seq = seq
@@ -53,6 +66,9 @@ class _PendingRequest:
         self.replies_by_replica: Dict[int, bytes] = {}
         self.count_by_digest: Dict[bytes, int] = {}
         self.result: asyncio.Future = loop.create_future()
+        # Marshaled REQUEST bytes, kept so a reconnecting replica stream can
+        # re-send everything still unresolved (see _run_connection).
+        self.data: Optional[bytes] = None
 
     def add_reply(self, reply: Reply) -> None:
         if reply.replica_id in self.replies_by_replica:
@@ -95,6 +111,7 @@ class Client:
         self._queues: Dict[int, asyncio.Queue] = {}
         self._tasks: list = []
         self._started = False
+        self._log = logging.getLogger(f"minbft_tpu.client.{client_id}")
 
     # -- connections --------------------------------------------------------
 
@@ -116,31 +133,109 @@ class Client:
         self._tasks.clear()
         self._started = False
 
+    async def _outgoing(self, q: asyncio.Queue) -> AsyncIterator[bytes]:
+        # Coalesce a pipelined burst of requests into one transport
+        # frame — per-frame gRPC/asyncio cost dominates on small hosts
+        # (see core.message_handling's pump coalescing).
+        while True:
+            data, _ = drain_multi(await q.get(), q)
+            yield data
+
     async def _run_connection(
         self, replica_id: int, handler: api.MessageStreamHandler, q: asyncio.Queue
     ) -> None:
-        async def outgoing() -> AsyncIterator[bytes]:
-            # Coalesce a pipelined burst of requests into one transport
-            # frame — per-frame gRPC/asyncio cost dominates on small hosts
-            # (see core.message_handling's pump coalescing).
-            while True:
-                data, _ = drain_multi(await q.get(), q)
-                yield data
+        """One replica's stream, redialed with backoff when it drops.
 
-        try:
-            async for data in handler.handle_message_stream(outgoing()):
-                try:
-                    frames = split_multi(data)
-                except CodecError:
-                    continue
-                for fr in frames:
-                    await self._handle_reply(replica_id, fr)
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            # A faulty replica connection must not break the client: f+1
-            # matching replies from the others still complete requests.
-            pass
+        Mirrors core.message_handling.run_peer_connection: both connectors
+        dial a fresh connection per handle_message_stream call, so a network
+        blip or replica restart must not permanently cost the client a
+        reply vote — with only f+1 matching replies required, losing >f
+        streams forever would wedge every future request even though every
+        replica is healthy again.  Each redial swaps in a FRESH queue (the
+        dead attempt's outgoing pump may still hold q.get() and would steal
+        frames) and re-sends every still-pending request: frames drained
+        into the dying connection are otherwise lost, and replica-side
+        clientstate dedups the re-send (same reply re-served from cache)."""
+        backoff = ReconnectBackoff()
+        while True:
+            attempt_start = time.monotonic()
+            poisoned = False
+            # Per-STREAM counter (the constant's contract): carrying it
+            # across redials would tear every later stream down on its
+            # first failure.
+            consecutive_errors = 0
+            try:
+                async for data in handler.handle_message_stream(self._outgoing(q)):
+                    try:
+                        frames = split_multi(data)
+                    except CodecError:
+                        continue
+                    for fr in frames:
+                        # A poison frame (reply handling raising — only
+                        # local bugs or transient verifier/backend errors
+                        # reach here; auth and codec failures are swallowed
+                        # inside _handle_reply) costs the FRAME, not the
+                        # connection.  A run of them tears the stream down
+                        # for a BACKOFF redial — never permanently: a
+                        # transient verifier outage must not sever >f
+                        # streams forever (the wedge this loop exists to
+                        # prevent), while a deterministic bug self-throttles
+                        # at the ladder cap.
+                        try:
+                            await self._handle_reply(replica_id, fr)
+                            consecutive_errors = 0
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:
+                            consecutive_errors += 1
+                            self._log.exception(
+                                "client %d replica %d: reply handling failed "
+                                "(%d consecutive)",
+                                self.client_id,
+                                replica_id,
+                                consecutive_errors,
+                            )
+                            if consecutive_errors >= _MAX_CONSECUTIVE_REPLY_ERRORS:
+                                poisoned = True
+                                break
+                    if poisoned:
+                        break
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # A faulty replica connection must not break the client: f+1
+                # matching replies from the others still complete requests.
+                # But an operator debugging missing reply votes needs the
+                # cause (auth failure vs refused vs codec bug) on record.
+                self._log.warning(
+                    "client %d replica %d stream failed: %s",
+                    self.client_id,
+                    replica_id,
+                    e,
+                )
+            delay = backoff.next_delay(time.monotonic() - attempt_start)
+            q = asyncio.Queue()
+            self._queues[replica_id] = q
+            resent = 0
+            for pending in self._pending.values():
+                if (
+                    pending.data is not None
+                    and not pending.result.done()
+                    # this replica already voted: its clientstate would only
+                    # re-serve a reply add_reply discards as a duplicate
+                    and replica_id not in pending.replies_by_replica
+                ):
+                    q.put_nowait(pending.data)
+                    resent += 1
+            self._log.debug(
+                "client %d replica %d stream ended: redialing in %.1fs "
+                "(%d pending re-sent)",
+                self.client_id,
+                replica_id,
+                delay,
+                resent,
+            )
+            await asyncio.sleep(delay)
 
     async def _handle_reply(self, replica_id: int, data: bytes) -> None:
         try:
@@ -189,6 +284,7 @@ class Client:
             pending = _PendingRequest(seq, self.f, asyncio.get_running_loop())
             self._pending[seq] = pending
             data = marshal(req)
+            pending.data = data
             self._broadcast(data)
             try:
                 if self._retransmit_interval is not None:

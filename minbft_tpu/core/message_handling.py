@@ -58,6 +58,7 @@ from . import timeout as timeout_mod
 from . import checkpoint as checkpoint_mod
 from . import usig_ui, utils
 from . import viewchange as viewchange_mod
+from ..utils.backoff import ReconnectBackoff
 from ..utils.metrics import ReplicaMetrics
 from .internal.clientstate import ClientStates
 from .internal.messagelog import MessageLog
@@ -1537,6 +1538,12 @@ class _ConcurrentStreamProcessor:
         """Wait for every in-flight message task to finish."""
         while self._tasks:
             await asyncio.gather(*list(self._tasks), return_exceptions=True)
+            # Awaiting already-done tasks does NOT suspend, but the
+            # done-callbacks that prune _tasks ride call_soon — yield one
+            # loop turn so they run, or this spins forever (and a spinning
+            # coroutine starves the event loop, so no wait_for timeout can
+            # ever rescue the caller).
+            await asyncio.sleep(0)
 
     def cancel(self) -> None:
         for t in self._tasks:
@@ -1789,7 +1796,17 @@ async def run_peer_connection(
     the primary's PREPAREs and every peer's COMMITs — and serial handling
     here would head-of-line-block on each quorum round-trip, starving the
     verification batches.  Per-peer processing *order* is still enforced
-    downstream by in-order UI capture."""
+    downstream by in-order UI capture.
+
+    The dial loop RECONNECTS with backoff when the stream ends or fails
+    (network blip, peer crash/restart): without it a survivor would
+    permanently stop receiving this peer's broadcast log — peer A's
+    messages reach B only over B's dial to A, so a single dropped
+    connection silently halves the link forever.  Reconnection is safe by
+    design: the peer's HELLO replay re-streams its retained log, already-
+    captured messages dedup at capture, and the validated-check memo makes
+    re-validation cheap.  A run of consecutive INTERNAL errors still tears
+    the connection down permanently (a local bug would loop forever)."""
 
     async def outgoing() -> AsyncIterator[bytes]:
         hello = Hello(replica_id=handlers.replica_id)
@@ -1821,29 +1838,75 @@ async def run_peer_connection(
         # connection down; sporadic transients never accumulate.
         internal["consecutive"] = 0
 
-    proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop, _ok)
-    try:
-        async for data in stream_handler.handle_message_stream(outgoing()):
-            if done.is_set():
-                break
-            if internal["consecutive"] >= _MAX_CONSECUTIVE_INTERNAL_ERRORS:
-                handlers.log.error(
-                    "peer %d connection closed: %d consecutive internal "
-                    "processing errors",
-                    peer_id,
-                    internal["consecutive"],
-                )
-                break
-            try:
-                frames = split_multi(data)
-            except CodecError as e:
-                _drop(e)
-                continue
-            for fr in frames:
-                await proc.submit(fr)
-    except asyncio.CancelledError:
-        raise
-    except Exception:
-        handlers.log.exception("peer %d connection failed", peer_id)
-    finally:
-        proc.cancel()
+    backoff = ReconnectBackoff()
+    while not done.is_set():
+        proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop, _ok)
+        attempt_start = time.monotonic()
+        cancelled = False
+        # Per-STREAM counter (see _MAX_CONSECUTIVE_INTERNAL_ERRORS): errors
+        # accumulated across redials must not add up to a permanent
+        # teardown — that would rebuild the silent link-halving wedge
+        # reconnection exists to prevent.
+        internal["consecutive"] = 0
+        try:
+            async for data in stream_handler.handle_message_stream(outgoing()):
+                if done.is_set():
+                    break
+                if internal["consecutive"] >= _MAX_CONSECUTIVE_INTERNAL_ERRORS:
+                    handlers.log.error(
+                        "peer %d connection closed: %d consecutive internal "
+                        "processing errors",
+                        peer_id,
+                        internal["consecutive"],
+                    )
+                    return
+                try:
+                    frames = split_multi(data)
+                except CodecError as e:
+                    _drop(e)
+                    continue
+                for fr in frames:
+                    await proc.submit(fr)
+        except asyncio.CancelledError:
+            cancelled = True
+            raise
+        except Exception:
+            handlers.log.exception("peer %d connection failed", peer_id)
+        finally:
+            # Lived time is the STREAM's lifetime: measured before the
+            # drain, which can add up to 30s a crash-looping peer never
+            # earned toward the ladder's lived-connection reset.
+            lived = time.monotonic() - attempt_start
+            # A dropped stream must not cancel handlers mid-flight: a task
+            # cancelled between UI capture and apply loses that message
+            # FOREVER (the reconnect replay dedups at capture), so let
+            # in-flight work finish first — bounded, because a handler
+            # parked on a pathological wait must not stall the redial.
+            # Skipped entirely on shutdown/cancellation: replay-loss no
+            # longer matters and stop() must not stall 30s behind a
+            # handler parked on a wait its dying peers can never resolve.
+            if cancelled or done.is_set():
+                proc.cancel()
+            else:
+                try:
+                    await asyncio.wait_for(asyncio.shield(proc.drain()), 30.0)
+                except asyncio.TimeoutError:
+                    pass
+                except asyncio.CancelledError:
+                    # Cancelled mid-drain by a cancel-only caller: the
+                    # cancellation must win, not be eaten into a redial.
+                    proc.cancel()
+                    raise
+                proc.cancel()
+        if done.is_set():
+            return
+        delay = backoff.next_delay(lived)
+        handlers.metrics.inc("peer_reconnects")
+        handlers.log.warning(
+            "peer %d stream ended: reconnecting in %.1fs", peer_id, delay
+        )
+        try:
+            await asyncio.wait_for(done.wait(), delay)
+            return  # shutdown during the backoff
+        except asyncio.TimeoutError:
+            pass

@@ -1,0 +1,41 @@
+"""Reconnect backoff policy shared by the peer and client redial loops.
+
+Both `core.message_handling.run_peer_connection` and
+`client.Client._run_connection` redial dropped streams (the reference
+instead relies on operators restarting peers, core/message-handling.go:
+316-350 HELLO replay handles only the receiving side).  The ladder lives
+here once so the two loops cannot drift apart.
+"""
+
+from __future__ import annotations
+
+
+class ReconnectBackoff:
+    """Exponential redial ladder with a lived-connection reset.
+
+    A connection that survived longer than ``lived_reset_s`` was healthy
+    (not a crash-looping peer whose replay counts as liveness every
+    attempt), so the next failure restarts the ladder at ``start_s``.
+    """
+
+    def __init__(
+        self,
+        start_s: float = 0.2,
+        cap_s: float = 10.0,
+        lived_reset_s: float = 5.0,
+        factor: float = 2.0,
+    ):
+        self._start = start_s
+        self._cap = cap_s
+        self._lived = lived_reset_s
+        self._factor = factor
+        self._delay = start_s
+
+    def next_delay(self, attempt_lived_s: float) -> float:
+        """Delay before the next dial, given how long the last attempt
+        lived.  Advances the ladder."""
+        if attempt_lived_s > self._lived:
+            self._delay = self._start
+        delay = self._delay
+        self._delay = min(self._delay * self._factor, self._cap)
+        return delay

@@ -141,3 +141,71 @@ def test_ed25519_scheme_cluster_commit():
             await r.stop()
 
     asyncio.run(run())
+
+
+class _FlakyClientConnector(api.ReplicaConnector):
+    """Every replica's FIRST stream swallows one frame and dies — the
+    mid-flight connection drop the client's reconnect loop exists for.
+    Later attempts delegate to the real connector."""
+
+    def __init__(self, inner: api.ReplicaConnector):
+        self._inner = inner
+        self.attempts: dict = {}
+
+    def replica_message_stream_handler(self, replica_id):
+        inner_handler = self._inner.replica_message_stream_handler(replica_id)
+        if inner_handler is None:
+            return None
+        outer = self
+
+        class _Flaky(api.MessageStreamHandler):
+            async def handle_message_stream(self, in_stream):
+                n = outer.attempts.get(replica_id, 0) + 1
+                outer.attempts[replica_id] = n
+                if n == 1:
+                    # consume the request, then the connection drops: the
+                    # frame is gone — no retransmit timer is configured, so
+                    # only the reconnect re-send can ever recover it
+                    async for _ in in_stream:
+                        return
+                    yield b""  # pragma: no cover - async-generator marker
+                    return
+                async for out in inner_handler.handle_message_stream(in_stream):
+                    yield out
+
+        return _Flaky()
+
+
+def test_client_reconnects_after_stream_drop():
+    """A dropped replica stream is redialed with backoff and every pending
+    request re-sent: losing >f streams permanently would wedge all future
+    requests (f+1 matching replies needed) even with healthy replicas."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        conn = _FlakyClientConnector(InProcessClientConnector(stubs))
+        client = new_client(0, 4, 1, c_auths[0], conn, seq_start=0)
+        await client.start()
+        # no retransmit_interval: completion proves the reconnect re-send
+        result = await asyncio.wait_for(client.request(b"flaky-op"), 30)
+        assert result
+        assert all(n >= 2 for n in conn.attempts.values()), conn.attempts
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_reconnect_backoff_ladder():
+    """Shared redial policy: exponential growth to the cap, reset only
+    after a lived connection (a crash-looping peer must not be rewarded)."""
+    from minbft_tpu.utils.backoff import ReconnectBackoff
+
+    b = ReconnectBackoff(start_s=0.2, cap_s=10.0, lived_reset_s=5.0)
+    assert [b.next_delay(0.0) for _ in range(7)] == [
+        0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 10.0,
+    ]
+    assert b.next_delay(0.0) == 10.0  # pinned at the cap
+    assert b.next_delay(6.0) == 0.2   # lived >5s: ladder restarts
+    assert b.next_delay(0.1) == 0.4

@@ -684,3 +684,65 @@ def test_malformed_multi_frame_is_dropped_not_fatal():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_peer_connection_reconnects_after_stream_ends():
+    """A dropped peer stream (blip, peer restart) is redialed with
+    backoff: peer A's messages reach B only over B's dial to A, so a
+    one-shot dial would silently halve the link forever.  Each attempt
+    re-sends HELLO; processing resumes on the new stream."""
+
+    async def scenario():
+        from minbft_tpu.core.message_handling import run_peer_connection
+
+        h = _handlers(replica_id=0)
+        handled = []
+
+        async def record(msg):
+            handled.append(msg)
+            return True
+
+        h.handle_peer_message = record
+
+        hellos = []
+
+        class FlakyHandler(api.MessageStreamHandler):
+            """First two streams die after their replay; the third lives.
+            Each attempt REPLAYS the peer's whole log so far (the real
+            HELLO replay-then-follow semantics — which is what makes a
+            mid-processing cancellation on a dying stream harmless)."""
+
+            def __init__(self):
+                self.calls = 0
+
+            async def handle_message_stream(self, in_stream):
+                self.calls += 1
+                hellos.append(await in_stream.__anext__())
+                for cv in range(1, self.calls + 1):
+                    yield marshal(_prepare(cv=cv, view=0, primary=1))
+                if self.calls >= 3:
+                    await asyncio.sleep(30)  # a healthy, open stream
+
+        done = asyncio.Event()
+        fh = FlakyHandler()
+        task = asyncio.ensure_future(run_peer_connection(h, 1, fh, done))
+        for _ in range(200):
+            if len({m.ui.counter for m in handled}) >= 3:
+                break
+            await asyncio.sleep(0.02)
+        done.set()
+        task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await task
+        assert fh.calls >= 3, f"no reconnects: {fh.calls} dials"
+        assert len({m.ui.counter for m in handled}) >= 3, (
+            "replayed messages after reconnect not processed"
+        )
+        assert h.metrics.counters.get("peer_reconnects", 0) >= 2
+        # every attempt opened with a fresh signed HELLO
+        for raw in hellos[:3]:
+            m = unmarshal(raw)
+            assert isinstance(m, Hello) and m.signature
+        return True
+
+    assert asyncio.run(scenario())
