@@ -209,3 +209,63 @@ def test_reconnect_backoff_ladder():
     assert b.next_delay(0.0) == 10.0  # pinned at the cap
     assert b.next_delay(6.0) == 0.2   # lived >5s: ladder restarts
     assert b.next_delay(0.1) == 0.4
+
+
+class _ChaosClientConnector(api.ReplicaConnector):
+    """Kills every stream after it has delivered ``frames_per_life`` reply
+    frames — repeated mid-run drops under pipelined load, the worst case
+    for the redial loop's queue swap + pending re-send."""
+
+    def __init__(self, inner: api.ReplicaConnector, frames_per_life: int):
+        self._inner = inner
+        self._frames_per_life = frames_per_life
+        self.drops = 0
+
+    def replica_message_stream_handler(self, replica_id):
+        inner_handler = self._inner.replica_message_stream_handler(replica_id)
+        if inner_handler is None:
+            return None
+        outer = self
+
+        class _Chaos(api.MessageStreamHandler):
+            async def handle_message_stream(self, in_stream):
+                served = 0
+                async for out in inner_handler.handle_message_stream(in_stream):
+                    yield out
+                    served += 1
+                    if served >= outer._frames_per_life:
+                        outer.drops += 1
+                        return  # the connection dies mid-conversation
+
+        return _Chaos()
+
+
+def test_client_pipelined_load_survives_repeated_stream_drops():
+    """30 pipelined requests complete while every replica stream dies
+    after each 3 delivered frames — the redial loop must keep swapping
+    queues and re-sending without losing or double-counting any request."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        conn = _ChaosClientConnector(InProcessClientConnector(stubs), 3)
+        client = new_client(0, 4, 1, c_auths[0], conn, seq_start=0, max_inflight=10)
+        await client.start()
+        results = await asyncio.wait_for(
+            asyncio.gather(
+                *(client.request(b"chaos-%d" % i) for i in range(30))
+            ),
+            60,
+        )
+        assert all(results)
+        assert conn.drops > 0, "chaos connector never dropped a stream"
+        # exactly-once execution despite every re-send
+        for _ in range(100):
+            if all(lg.length == 30 for lg in ledgers):
+                break
+            await asyncio.sleep(0.05)
+        assert all(lg.length == 30 for lg in ledgers), [lg.length for lg in ledgers]
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
