@@ -18,6 +18,7 @@ Environment knobs:
   MINBFT_BENCH_SLO_P50_MS   latency target for the *_at_p50_* runs (500)
   MINBFT_BENCH_SKIP_E2E / _SKIP_MP / _SKIP_NODEDUP / _SKIP_SLO /
   _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519   phase gates
+  MINBFT_BENCH_SKIP_PREFLIGHT=1   skip the backend-retry pre-flight
   MINBFT_BENCH_CFG{1,2,4,5}_REQUESTS, _MAC_REQUESTS, _ISO_REQUESTS,
   _NODEDUP_REQUESTS, _NODEDUPREF_REQUESTS      per-config run lengths
 """
@@ -26,10 +27,53 @@ import asyncio
 import hashlib
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for_backend() -> None:
+    """Pre-flight the accelerator backend in SUBPROCESSES with retries.
+
+    The tunneled TPU's remote service flakes (observed: init hangs or
+    'Unable to initialize backend axon: UNAVAILABLE' for tens of minutes,
+    then recovers).  jax caches a failed backend init for the process
+    lifetime, so retrying must happen out-of-process BEFORE this process
+    first touches jax.devices().  Worst case (every probe hangs to its
+    120s timeout + 60s sleeps) is ~24 minutes; after that, proceeds and
+    lets the in-process init raise the real error.  Instant no-op on
+    healthy backends (CPU included); skip with
+    MINBFT_BENCH_SKIP_PREFLIGHT=1."""
+    probe = "import jax; jax.devices()"
+    attempts = 8
+    for attempt in range(attempts):
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=120,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+            rc, err = res.returncode, res.stderr
+        except subprocess.TimeoutExpired:
+            rc, err = -1, b"(probe hung past 120s)"
+        if rc == 0:
+            return
+        tail = err.decode(errors="replace").strip().splitlines()[-1:] or [""]
+        print(
+            f"bench: backend not ready (probe {attempt + 1}/{attempts}, "
+            f"rc={rc}): {tail[0][:200]}",
+            file=sys.stderr,
+            flush=True,
+        )
+        if attempt + 1 < attempts:
+            time.sleep(60)
+
+
+if os.environ.get("MINBFT_BENCH_SKIP_PREFLIGHT") != "1":
+    _wait_for_backend()
 
 import jax
 
