@@ -436,3 +436,105 @@ def test_late_replica_joins_via_state_transfer_over_sockets(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def test_killed_replica_rejoins_after_restart(tmp_path):
+    """Survivors REDIAL a killed-and-restarted replica (reconnect with
+    backoff): before stream self-healing, an established peer connection
+    that died was never redialed, so a restarted replica received no
+    broadcasts and was silently lost to the cluster.  Proven load-bearing
+    by killing a DIFFERENT replica afterwards — the final request can only
+    reach its n-f=3 quorum if the restarted replica participates.
+    (The manual variant is the kill/restart drive in the verify recipe;
+    the late-joiner test above covers the never-connected case, which
+    worked even pre-reconnect via the initial dial window.)"""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        CONSENSUS_TIMEOUT_REQUEST="60s",
+        CONSENSUS_TIMEOUT_PREPARE="30s",
+    )
+    d = str(tmp_path)
+    base_port = _free_base_port(4)
+
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "4", "-d", d, "--base-port", str(base_port),
+         "--usig", "SOFT_ECDSA"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = {}
+    logs = []
+
+    def start_replica(i):
+        log = open(f"{d}/replica{i}.log", "ab")
+        logs.append(log)
+        replicas[i] = subprocess.Popen(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "--transport", "tcp", "run", str(i), "--no-batch"],
+            env=env, stdout=subprocess.DEVNULL, stderr=log,
+        )
+
+    def req(op, timeout=120):
+        r = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "--transport", "tcp", "request", op, "--timeout", str(timeout)],
+            env=env, capture_output=True, text=True, timeout=timeout + 60,
+        )
+        assert r.returncode == 0, f"{op}: {r.stderr[-800:]}"
+
+    try:
+        for i in range(4):
+            start_replica(i)
+        assert _wait_ports([base_port + i for i in range(4)]), "never bound"
+
+        req("before-kill")
+
+        # snapshot log sizes so the redial assertion below cannot be
+        # satisfied by cluster-formation dial noise from before the kill
+        survivor_logs = [f"{d}/replica{i}.log" for i in range(3)]
+        pre_kill = [os.path.getsize(p) for p in survivor_logs]
+
+        replicas[3].kill()  # SIGKILL: no graceful close on any stream
+        replicas[3].wait(timeout=10)
+        req("while-down")  # 3/4 still commits
+
+        start_replica(3)
+        assert _wait_ports([base_port + 3]), "restarted replica never bound"
+
+        # every survivor's ESTABLISHED stream to 3 died at the kill and
+        # must have entered the redial ladder (post-kill bytes only)
+        def redialed_peer3() -> bool:
+            for p, off in zip(survivor_logs, pre_kill):
+                with open(p, "rb") as fh:
+                    fh.seek(off)
+                    if b"peer 3 stream ended: reconnecting" in fh.read():
+                        return True
+            return False
+
+        deadline = time.time() + 30
+        while time.time() < deadline and not redialed_peer3():
+            time.sleep(0.5)
+        assert redialed_peer3(), "no survivor ever redialed the killed peer"
+
+        # ladder caps at 10s: give every survivor time to re-establish,
+        # then make the restarted replica LOAD-BEARING for the quorum
+        time.sleep(12)
+        replicas[2].kill()
+        replicas[2].wait(timeout=10)
+        req("rejoined-load-bearing", timeout=150)
+    finally:
+        for p in replicas.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
