@@ -293,7 +293,7 @@ def make_view_change_validator(verify_ui, validate_cert=None):
                     "VIEW-CHANGE log_base exceeds the certified coverage "
                     "bounds: the dropped prefix is not provably covered"
                 )
-        checks = []
+        to_verify = []
         base = vc.log_base
         for i, entry in enumerate(vc.log):
             if entry.replica_id != vc.replica_id:
@@ -326,14 +326,18 @@ def make_view_change_validator(verify_ui, validate_cert=None):
                         "VIEW-CHANGE stubs an entry the certificate does "
                         "not cover"
                     )
-            checks.append(verify_ui(entry))
+            to_verify.append(entry)
             if isinstance(entry, Commit):
-                checks.append(verify_ui(entry.prepare))
+                to_verify.append(entry.prepare)
         # Entry checks are stateless: gather them so they co-batch on the
         # verification engine (the log grows with the checkpoint window —
         # one serial engine round-trip per entry would stall recovery; the
         # gather collapses them to ~one batch, prepare.py's house pattern).
-        results = await asyncio.gather(*checks, return_exceptions=True)
+        # Coroutines are created HERE, not in the loop: a raise mid-loop
+        # would leak the already-created, never-awaited calls.
+        results = await asyncio.gather(
+            *(verify_ui(e) for e in to_verify), return_exceptions=True
+        )
         for res in results:
             if isinstance(res, BaseException):
                 raise res
