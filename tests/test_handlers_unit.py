@@ -746,3 +746,105 @@ def test_peer_connection_reconnects_after_stream_ends():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_peer_connection_internal_error_teardown_is_permanent():
+    """A wedged local handler (non-codec, non-auth exceptions on EVERY
+    message) must close the peer connection loudly and PERMANENTLY — a
+    deterministic local bug redialing forever would loop without end."""
+
+    async def scenario():
+        from minbft_tpu.core.message_handling import (
+            _MAX_CONSECUTIVE_INTERNAL_ERRORS,
+            run_peer_connection,
+        )
+
+        h = _handlers(replica_id=0)
+
+        async def broken(msg):
+            raise RuntimeError("wedged handler")
+
+        h.handle_peer_message = broken
+
+        class Stream(api.MessageStreamHandler):
+            def __init__(self):
+                self.calls = 0
+
+            async def handle_message_stream(self, in_stream):
+                self.calls += 1
+                await in_stream.__anext__()
+                for cv in range(1, _MAX_CONSECUTIVE_INTERNAL_ERRORS + 9):
+                    yield marshal(_prepare(cv=cv, view=0, primary=1))
+                    await asyncio.sleep(0)  # let the error counter advance
+                await asyncio.sleep(30)  # stream stays open: only the
+                # teardown check can end the connection
+
+        done = asyncio.Event()
+        st = Stream()
+        task = asyncio.ensure_future(run_peer_connection(h, 1, st, done))
+        await asyncio.wait_for(task, 20)  # returns on its own: permanent
+        assert st.calls == 1, f"redialed a wedged-handler teardown: {st.calls}"
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_peer_connection_internal_errors_reset_per_stream():
+    """Internal-error counts must NOT accumulate across redials: two
+    streams each below the teardown threshold (but above it combined)
+    followed by a healthy stream must still reconnect and process — a
+    transient outage spanning a redial is not a wedged handler."""
+
+    async def scenario():
+        from minbft_tpu.core.message_handling import (
+            _MAX_CONSECUTIVE_INTERNAL_ERRORS,
+            run_peer_connection,
+        )
+
+        h = _handlers(replica_id=0)
+        handled = []
+        flaky = {"on": True}
+
+        async def sometimes_broken(msg):
+            if flaky["on"]:
+                raise RuntimeError("transient backend outage")
+            handled.append(msg)
+            return True
+
+        h.handle_peer_message = sometimes_broken
+        per_stream = _MAX_CONSECUTIVE_INTERNAL_ERRORS - 8
+        # keep the guard honest if the constant is ever retuned
+        assert per_stream > 0 and 2 * per_stream > _MAX_CONSECUTIVE_INTERNAL_ERRORS
+
+        class Stream(api.MessageStreamHandler):
+            def __init__(self):
+                self.calls = 0
+
+            async def handle_message_stream(self, in_stream):
+                self.calls += 1
+                await in_stream.__anext__()
+                if self.calls <= 2:
+                    for cv in range(1, per_stream + 1):
+                        yield marshal(_prepare(cv=cv, view=0, primary=1))
+                        await asyncio.sleep(0)
+                    return  # stream dies; errors so far < threshold
+                flaky["on"] = False  # outage over
+                yield marshal(_prepare(cv=1, view=0, primary=1))
+                await asyncio.sleep(30)
+
+        done = asyncio.Event()
+        st = Stream()
+        task = asyncio.ensure_future(run_peer_connection(h, 1, st, done))
+        for _ in range(300):
+            if handled:
+                break
+            await asyncio.sleep(0.02)
+        done.set()
+        task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await task
+        assert st.calls >= 3, f"connection closed before the outage cleared: {st.calls}"
+        assert handled, "healthy stream after the outage was never processed"
+        return True
+
+    assert asyncio.run(scenario())
