@@ -269,3 +269,70 @@ def test_client_pipelined_load_survives_repeated_stream_drops():
             await r.stop()
 
     asyncio.run(run())
+
+
+class _CountingConnector(api.ReplicaConnector):
+    """Transparent passthrough that counts dials per replica."""
+
+    def __init__(self, inner: api.ReplicaConnector):
+        self._inner = inner
+        self.dials: dict = {}
+
+    def replica_message_stream_handler(self, replica_id):
+        inner_handler = self._inner.replica_message_stream_handler(replica_id)
+        if inner_handler is None:
+            return None
+        outer = self
+
+        class _C(api.MessageStreamHandler):
+            async def handle_message_stream(self, in_stream):
+                outer.dials[replica_id] = outer.dials.get(replica_id, 0) + 1
+                async for out in inner_handler.handle_message_stream(in_stream):
+                    yield out
+
+        return _C()
+
+
+def test_client_reply_verifier_outage_poisons_stream_but_never_severs():
+    """Non-auth exceptions in reply handling (e.g. a transient verifier
+    backend outage) cost frames, then — after a consecutive run — the
+    STREAM (backoff redial), but never the connection permanently: a
+    transient outage severing >f streams forever would wedge every future
+    request against healthy replicas."""
+
+    async def run():
+        from minbft_tpu.client.client import _MAX_CONSECUTIVE_REPLY_ERRORS
+
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        auth = c_auths[0]
+        real_verify = auth.verify_message_authen_tag
+        state = {"fail": True, "raised": 0}
+        # pigeonhole: this many raises across 4 streams forces at least
+        # one stream past the per-stream guard, whatever its value
+        outage = 4 * _MAX_CONSECUTIVE_REPLY_ERRORS + 4
+
+        async def flaky_verify(role, rid, data, sig):
+            if state["fail"] and role == api.AuthenticationRole.REPLICA:
+                state["raised"] += 1
+                if state["raised"] >= outage:
+                    state["fail"] = False
+                raise RuntimeError("verifier backend outage")
+            return await real_verify(role, rid, data, sig)
+
+        auth.verify_message_authen_tag = flaky_verify
+        conn = _CountingConnector(InProcessClientConnector(stubs))
+        client = new_client(
+            0, 4, 1, auth, conn, seq_start=0, retransmit_interval=0.05
+        )
+        await client.start()
+        result = await asyncio.wait_for(client.request(b"verifier-outage"), 30)
+        assert result
+        # at least one stream hit the consecutive-failure guard and was
+        # redialed rather than severed
+        assert max(conn.dials.values()) >= 2, conn.dials
+        assert state["raised"] >= outage - 4, state
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
