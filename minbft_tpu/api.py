@@ -172,6 +172,21 @@ class RequestConsumer(abc.ABC):
             f"{type(self).__name__} does not support state snapshots"
         )
 
+    def query(self, operation: bytes) -> "Awaitable[bytes]":
+        """Answer a READ-ONLY operation from current committed state,
+        without ordering it (the reference lists read-only requests as a
+        roadmap item, README.md:503-504).  Must be deterministic in the
+        state: replicas at the same committed prefix return the same
+        bytes, because the client accepts a fast read only when ALL n
+        replies match (the n=2f+1 read-quorum bound: any smaller quorum
+        cannot guarantee intersection with a write quorum in a correct
+        replica).  Optional — replicas whose consumer lacks it drop
+        read-only requests, and the client falls back to an ordered
+        request."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support read-only queries"
+        )
+
     def install_snapshot(self, data: bytes) -> None:
         """Atomically replace the application state with a snapshot.
         Implementations must validate internal integrity and leave the

@@ -53,16 +53,26 @@ _MAX_CONSECUTIVE_REPLY_ERRORS = 10
 class _PendingRequest:
     __slots__ = (
         "seq",
-        "f",
+        "threshold",
+        "read_only",
         "replies_by_replica",
         "count_by_digest",
         "result",
         "data",
     )
 
-    def __init__(self, seq: int, f: int, loop: asyncio.AbstractEventLoop):
+    def __init__(
+        self,
+        seq: int,
+        threshold: int,
+        loop: asyncio.AbstractEventLoop,
+        read_only: bool = False,
+    ):
         self.seq = seq
-        self.f = f
+        # f+1 matching replies for ordered requests; ALL n for read-only
+        # fast reads (the n=2f+1 read-quorum bound — see Client.request).
+        self.threshold = threshold
+        self.read_only = read_only
         self.replies_by_replica: Dict[int, bytes] = {}
         self.count_by_digest: Dict[bytes, int] = {}
         self.result: asyncio.Future = loop.create_future()
@@ -71,13 +81,15 @@ class _PendingRequest:
         self.data: Optional[bytes] = None
 
     def add_reply(self, reply: Reply) -> None:
+        if reply.read_only != self.read_only:
+            return  # an ordered reply cannot complete a read, nor vice versa
         if reply.replica_id in self.replies_by_replica:
             return  # one vote per replica (reference requestbuffer.go:219-236)
         self.replies_by_replica[reply.replica_id] = reply.result
         digest = hashlib.sha256(reply.result).digest()
         cnt = self.count_by_digest.get(digest, 0) + 1
         self.count_by_digest[digest] = cnt
-        if cnt >= self.f + 1 and not self.result.done():
+        if cnt >= self.threshold and not self.result.done():
             self.result.set_result(reply.result)
 
 
@@ -266,22 +278,82 @@ class Client:
 
     # -- requests -----------------------------------------------------------
 
-    async def request(self, operation: bytes, timeout: Optional[float] = None) -> bytes:
+    async def request(
+        self,
+        operation: bytes,
+        timeout: Optional[float] = None,
+        read_only: bool = False,
+        read_timeout: float = 1.0,
+        read_fallback: bool = True,
+    ) -> bytes:
         """Submit an operation; resolves once f+1 replicas agree on the
         result (reference client/client.go:66-71 Request).  Many requests
-        may be pipelined concurrently (bounded by ``max_inflight``)."""
+        may be pipelined concurrently (bounded by ``max_inflight``).
+
+        ``read_only=True`` takes the fast path (reference roadmap
+        README.md:503-504): replicas answer from committed state without
+        ordering, and the read is accepted only when ALL n replies match —
+        with n=2f+1 a read quorum below n cannot be guaranteed to
+        intersect a write quorum in a correct replica, so any smaller
+        threshold could return stale data.  If the cluster disagrees (a
+        write is in flight, a replica lags or is down), the fast read
+        times out after ``read_timeout`` and, with ``read_fallback``,
+        the operation is resubmitted as an ordered request — the same
+        degradation PBFT's read-only optimization uses."""
         if not self._started:
             raise RuntimeError("client not started")
+        mode = 0
+        if read_only:
+            deadline = (
+                None if timeout is None else time.monotonic() + timeout
+            )
+            ro_wait = (
+                read_timeout if timeout is None else min(read_timeout, timeout)
+            )
+            # Fast reads respect max_inflight too: the pipelining bound is
+            # an operator cap on replica load, and query work is load.
+            if self._inflight is not None:
+                await self._inflight.acquire()
+            try:
+                return await self._request_read_only(operation, ro_wait)
+            except asyncio.TimeoutError:
+                if not read_fallback:
+                    raise
+            finally:
+                if self._inflight is not None:
+                    self._inflight.release()
+            if deadline is not None and deadline - time.monotonic() <= 0.005:
+                # The fast attempt consumed the caller's whole budget:
+                # signing + broadcasting a fallback that times out in
+                # microseconds only wastes consensus work.
+                raise asyncio.TimeoutError()
+            # Fall through to the ordered pipeline as an ORDERED read
+            # (read_mode=2): consensus linearizes it, execution answers
+            # via consumer.query — no state mutation, f+1 reply quorum.
+            mode = 2
+            timeout = (
+                None if deadline is None else deadline - time.monotonic()
+            )
         if self._inflight is not None:
             await self._inflight.acquire()
         try:
             self._seq += 1
             seq = self._seq
-            req = Request(client_id=self.client_id, seq=seq, operation=operation)
+            req = Request(
+                client_id=self.client_id,
+                seq=seq,
+                operation=operation,
+                read_mode=mode,
+            )
             req.signature = self._auth.generate_message_authen_tag(
                 api.AuthenticationRole.CLIENT, authen_bytes(req)
             )
-            pending = _PendingRequest(seq, self.f, asyncio.get_running_loop())
+            pending = _PendingRequest(
+                seq,
+                self.f + 1,
+                asyncio.get_running_loop(),
+                read_only=bool(mode),
+            )
             self._pending[seq] = pending
             data = marshal(req)
             pending.data = data
@@ -297,6 +369,31 @@ class Client:
         finally:
             if self._inflight is not None:
                 self._inflight.release()
+
+    async def _request_read_only(self, operation: bytes, wait: float) -> bytes:
+        """One fast-read attempt: broadcast, require ALL n matching."""
+        self._seq += 1
+        seq = self._seq
+        req = Request(
+            client_id=self.client_id,
+            seq=seq,
+            operation=operation,
+            read_mode=1,
+        )
+        req.signature = self._auth.generate_message_authen_tag(
+            api.AuthenticationRole.CLIENT, authen_bytes(req)
+        )
+        pending = _PendingRequest(
+            seq, self.n, asyncio.get_running_loop(), read_only=True
+        )
+        self._pending[seq] = pending
+        data = marshal(req)
+        pending.data = data
+        self._broadcast(data)
+        try:
+            return await asyncio.wait_for(pending.result, wait)
+        finally:
+            self._pending.pop(seq, None)
 
     def _broadcast(self, data: bytes) -> None:
         for q in self._queues.values():

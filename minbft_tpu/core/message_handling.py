@@ -1387,6 +1387,13 @@ class Handlers:
         self.metrics.inc("messages_handled")
         self.metrics.inc("requests_received")
         await self.validate_message(msg)
+        if msg.is_fast_read:
+            # Fast path: answered from committed state, no ordering, no
+            # seq capture, no USIG — the caller's finally releases the
+            # arrival-order ticket (never waited on here).  Ordered reads
+            # (read_mode=2, the fallback) ride the normal pipeline below
+            # and execute via consumer.query at their slot.
+            return await self._reply_read_only(msg)
         if turn is not None:
             # Concurrent validations may complete out of order; capture
             # must happen in arrival order (see _TurnSequencer).  The turn
@@ -1408,6 +1415,30 @@ class Handlers:
         # nothing to send (the reference closes the reply channel without
         # sending, reply.go:74-79).
         return await self.reply_request(msg)
+
+    async def _reply_read_only(self, req: Request) -> Optional[Reply]:
+        """Answer a read-only REQUEST from committed state without
+        ordering it (the reference lists read-only requests as roadmap,
+        README.md:503-504).  Correctness: the client accepts the fast
+        read only when ALL n replies match — with n=2f+1, any smaller
+        read quorum cannot be guaranteed to intersect a write quorum in
+        a correct replica — and otherwise falls back to an ordered
+        request.  A consumer without query() support drops the request
+        into the same fallback."""
+        if type(self.consumer).query is api.RequestConsumer.query:
+            self.metrics.inc("readonly_unsupported")
+            return None
+        result = await self.consumer.query(req.operation)
+        reply = Reply(
+            replica_id=self.replica_id,
+            client_id=req.client_id,
+            seq=req.seq,
+            result=result,
+            read_only=True,
+        )
+        self.sign_message(reply)
+        self.metrics.inc("readonly_served")
+        return reply
 
     async def handle_peer_message(self, msg: Message) -> None:
         if isinstance(

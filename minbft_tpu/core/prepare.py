@@ -31,6 +31,15 @@ def make_prepare_validator(
                 f"PREPARE from non-primary replica {prepare.replica_id} "
                 f"in view {prepare.view}"
             )
+        for r in prepare.requests:
+            if r.is_fast_read:
+                # The client signed this as UNORDERED: a primary batching
+                # it would spend the client's seq on an ordering the
+                # client never authorized.  (Ordered reads, read_mode=2,
+                # batch fine — they execute via query at their slot.)
+                raise api.AuthenticationError(
+                    "PREPARE embeds a fast-read request"
+                )
         # Client signatures on every embedded request + the primary's UI,
         # batched into one engine round (the reference does these serially,
         # prepare.go:55-61).

@@ -39,6 +39,13 @@ def make_request_processor(
     pending, snapshot the view, apply."""
 
     async def process_request(request: Request) -> bool:
+        if request.is_fast_read:
+            # FAST reads are answered by the client-stream path and must
+            # never be ordered: a peer forwarding one (or a faulty client
+            # broadcasting it into the ordering path) would otherwise
+            # spend the seq on a request the client signed as unordered.
+            # Ordered reads (read_mode=2) proceed normally.
+            return False
         new = await capture_seq(request)
         if not new:
             return False
@@ -101,12 +108,31 @@ def make_request_executor(
             return False  # already executed (reference request.go:214-218)
         pending_requests.remove(request)
         stop_timers(request)
-        result = await consumer.deliver(request.operation)
+        if request.is_read:
+            # An ORDERED read (read_mode=2, the fast read's fallback):
+            # consensus fixes its place in the order — that is the
+            # linearization point — but execution must not mutate state.
+            # Deterministic across replicas: same slot -> same committed
+            # state -> same query result (also under log replay).
+            try:
+                result = await consumer.query(request.operation)
+            except NotImplementedError:
+                # The deployment's consumer cannot serve reads (a
+                # type-level property, so uniform across replicas): send
+                # NO reply rather than agree on a fabricated b"" the
+                # client cannot distinguish from a real empty result —
+                # its request times out, the protocol's honest
+                # "unsupported" signal.  Bookkeeping above already ran,
+                # identically everywhere, so checkpoints stay aligned.
+                return True
+        else:
+            result = await consumer.deliver(request.operation)
         reply = Reply(
             replica_id=replica_id,
             client_id=request.client_id,
             seq=request.seq,
             result=result,
+            read_only=request.is_read,
         )
         sign_message(reply)
         add_reply(reply)

@@ -68,10 +68,15 @@ def authen_bytes(m: Message) -> bytes:
 
 def _authen_bytes(m: Message) -> bytes:
     if isinstance(m, Request):
+        # read_mode is covered: flipping it in flight would bypass
+        # ordering (write→fast read), mutate state with a read
+        # (read→write), or silently weaken a fast read's all-n quorum
+        # (fast→ordered).
         return (
             b"REQUEST"
             + _U32.pack(m.client_id)
             + _U64.pack(m.seq)
+            + bytes([m.read_mode])
             + _sha256(m.operation)
         )
     if isinstance(m, Reply):
@@ -80,6 +85,7 @@ def _authen_bytes(m: Message) -> bytes:
             + _U32.pack(m.replica_id)
             + _U32.pack(m.client_id)
             + _U64.pack(m.seq)
+            + bytes([1 if m.read_only else 0])
             + _sha256(m.result)
         )
     if isinstance(m, Prepare):

@@ -94,6 +94,21 @@ def _read_bytes(data: bytes, off: int) -> Tuple[bytes, int]:
     return data[off : off + n], off + n
 
 
+def _read_bounded_byte(
+    data: bytes, off: int, bound: int, what: str
+) -> Tuple[int, int]:
+    """One strict bounded byte: values above ``bound`` are rejected so a
+    message has exactly ONE encoding (determinism is load-bearing for
+    signatures over marshaled bytes).  bound=1 decodes booleans; bound=2
+    the Request read_mode (0 write / 1 fast read / 2 ordered read)."""
+    if off + 1 > len(data):
+        raise CodecError(f"truncated {what}")
+    b = data[off]
+    if b > bound:
+        raise CodecError(f"invalid {what} byte")
+    return b, off + 1
+
+
 def _read_u32(data: bytes, off: int) -> Tuple[int, int]:
     if off + 4 > len(data):
         raise CodecError("truncated u32")
@@ -138,6 +153,7 @@ def marshal(m: Message) -> bytes:
             bytes([_TAG_REQUEST])
             + _pack_u32(m.client_id)
             + _pack_u64(m.seq)
+            + bytes([m.read_mode])
             + _pack_bytes(m.operation)
             + _pack_bytes(m.signature)
         )
@@ -147,6 +163,7 @@ def marshal(m: Message) -> bytes:
             + _pack_u32(m.replica_id)
             + _pack_u32(m.client_id)
             + _pack_u64(m.seq)
+            + bytes([1 if m.read_only else 0])
             + _pack_bytes(m.result)
             + _pack_bytes(m.signature)
         )
@@ -314,17 +331,32 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
     if tag == _TAG_REQUEST:
         cid, off = _read_u32(data, off)
         seq, off = _read_u64(data, off)
+        mode, off = _read_bounded_byte(data, off, 2, "read_mode")
         op, off = _read_bytes(data, off)
         sig, off = _read_bytes(data, off)
-        return Request(client_id=cid, seq=seq, operation=op, signature=sig), off
+        return (
+            Request(
+                client_id=cid, seq=seq, operation=op, signature=sig, read_mode=mode
+            ),
+            off,
+        )
     if tag == _TAG_REPLY:
         rid, off = _read_u32(data, off)
         cid, off = _read_u32(data, off)
         seq, off = _read_u64(data, off)
+        rb, off = _read_bounded_byte(data, off, 1, "read_only flag")
+        ro = bool(rb)
         result, off = _read_bytes(data, off)
         sig, off = _read_bytes(data, off)
         return (
-            Reply(replica_id=rid, client_id=cid, seq=seq, result=result, signature=sig),
+            Reply(
+                replica_id=rid,
+                client_id=cid,
+                seq=seq,
+                result=result,
+                signature=sig,
+                read_only=ro,
+            ),
             off,
         )
     if tag == _TAG_PREPARE:

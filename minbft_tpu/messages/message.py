@@ -89,6 +89,21 @@ class Request(Message):
     seq: int
     operation: bytes
     signature: bytes = b""
+    # Read-only support (reference roadmap README.md:503-504), covered by
+    # the client's signature (authen.py) so it cannot be flipped in
+    # flight: 0 = ordered write; 1 = FAST read (answered from committed
+    # state without ordering — never valid inside a PREPARE); 2 = ORDERED
+    # read (rides consensus for linearization but executes via
+    # consumer.query, mutating nothing — the fast read's fallback).
+    read_mode: int = 0
+
+    @property
+    def is_read(self) -> bool:
+        return self.read_mode != 0
+
+    @property
+    def is_fast_read(self) -> bool:
+        return self.read_mode == 1
 
 
 @dataclasses.dataclass
@@ -101,6 +116,9 @@ class Reply(Message):
     seq: int
     result: bytes
     signature: bytes = b""
+    # Marks a read-only fast-path answer; covered by the replica's
+    # signature so an ordered reply cannot be replayed as a read.
+    read_only: bool = False
 
 
 @dataclasses.dataclass(init=False)

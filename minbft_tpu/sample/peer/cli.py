@@ -199,6 +199,12 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
     q.add_argument(
         "--timeout", type=float, default=_opt("timeout", 30.0, section="request")
     )
+    q.add_argument(
+        "--read-only",
+        action="store_true",
+        help="read from committed state: fast path on all-n agreement, "
+        "ordered-read fallback otherwise (mutates nothing either way)",
+    )
 
     b = sub.add_parser(
         "bench",
@@ -375,7 +381,10 @@ async def _run_request(args) -> int:
     rc = 0
     try:
         for op in ops:
-            result = await asyncio.wait_for(client.request(op), args.timeout)
+            result = await asyncio.wait_for(
+                client.request(op, read_only=getattr(args, "read_only", False)),
+                args.timeout,
+            )
             print(result.hex())
     except asyncio.TimeoutError:
         print("peer: request timed out", file=sys.stderr)

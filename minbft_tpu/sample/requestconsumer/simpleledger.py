@@ -45,6 +45,25 @@ class SimpleLedger(api.RequestConsumer):
     def state_digest(self) -> bytes:
         return self._blocks[-1].digest()
 
+    async def query(self, operation: bytes) -> bytes:
+        """Read-only operations (api.RequestConsumer.query contract:
+        deterministic in committed state, since the client needs all n
+        replies to match).  Supported ops:
+
+        - ``b"head"`` (or anything unrecognized): chain height + head
+          digest — "what is the current state?"
+        - ``b"block:<height>"``: that block's digest, or empty bytes if
+          out of range.
+        """
+        if operation.startswith(b"block:"):
+            try:
+                blk = self.block(int(operation[6:]))
+            except ValueError:
+                blk = None
+            return blk.digest() if blk is not None else b""
+        head = self._blocks[-1]
+        return struct.pack(">Q", head.height) + head.digest()
+
     @property
     def length(self) -> int:
         """Number of blocks excluding genesis (reference ledger length

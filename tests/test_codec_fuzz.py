@@ -20,9 +20,17 @@ def _sample_messages():
     return [
         Hello(replica_id=2),
         req,
-        Reply(replica_id=1, client_id=3, seq=9, result=b"res", signature=b"s2"),
+        Reply(
+            replica_id=1,
+            client_id=3,
+            seq=9,
+            result=b"res",
+            signature=b"s2",
+            read_only=True,
+        ),
         prep,
         Commit(replica_id=4, prepare=prep, ui=UI(counter=6, cert=b"c2")),
+        Request(client_id=3, seq=10, operation=b"ro", read_mode=1),
     ]
 
 
@@ -38,7 +46,7 @@ def test_random_bytes_never_crash():
         assert marshal(m) == data
 
 
-@pytest.mark.parametrize("mi", range(5))
+@pytest.mark.parametrize("mi", range(6))
 def test_mutated_wire_bytes_never_crash(mi):
     rng = random.Random(99 + mi)
     base = marshal(_sample_messages()[mi])

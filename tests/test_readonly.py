@@ -1,0 +1,174 @@
+"""Read-only requests (the reference's roadmap item, README.md:503-504).
+
+Two modes, both covered by the client's signature (a flipped mode breaks
+authentication): FAST reads (read_mode=1) answered from committed state
+without ordering, accepted only on ALL n matching replies — with n=2f+1 a
+smaller read quorum cannot be guaranteed to intersect a write quorum in a
+correct replica — and ORDERED reads (read_mode=2), the fallback, which
+ride consensus for linearization but execute via consumer.query without
+mutating state."""
+
+import asyncio
+import struct
+
+import pytest
+
+from minbft_tpu import api
+from minbft_tpu.client import new_client
+from minbft_tpu.messages import Request, authen_bytes, marshal, unmarshal
+from minbft_tpu.messages.codec import CodecError
+from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+from conftest import make_cluster as _cluster
+
+
+def test_read_mode_codec_roundtrip_and_strictness():
+    for mode in (0, 1, 2):
+        r = Request(client_id=1, seq=7, operation=b"head", read_mode=mode)
+        out = unmarshal(marshal(r))
+        assert out.read_mode == mode
+        assert out.is_read == (mode != 0)
+        assert out.is_fast_read == (mode == 1)
+    # byte 3 (and anything above 2) has no meaning: one canonical encoding
+    data = bytearray(marshal(Request(client_id=1, seq=7, operation=b"x")))
+    data[1 + 4 + 8] = 3  # tag + client_id + seq -> the mode byte
+    with pytest.raises(CodecError, match="read_mode"):
+        unmarshal(bytes(data))
+
+
+def test_read_mode_is_signature_covered():
+    """Flipping the mode in flight must break the client's signature:
+    write→fast read would bypass ordering; read→write would mutate state
+    with an operation the client signed as a read."""
+    base = dict(client_id=1, seq=7, operation=b"op")
+    abytes = {
+        m: authen_bytes(Request(read_mode=m, **base)) for m in (0, 1, 2)
+    }
+    assert len(set(abytes.values())) == 3
+
+
+def test_fast_read_answers_without_ordering():
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        # a committed write, so the read has state to see; f+1 replies
+        # resolve before the slowest replica executes, so poll for all 4
+        await asyncio.wait_for(client.request(b"write-1"), 30)
+        for _ in range(100):
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        assert all(lg.length == 1 for lg in ledgers)
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True), 30
+        )
+        height = struct.unpack(">Q", head[:8])[0]
+        assert height == 1
+        assert head[8:] == ledgers[0].state_digest()
+        # the read ordered NOTHING and mutated NOTHING
+        await asyncio.sleep(0.2)
+        assert all(lg.length == 1 for lg in ledgers)
+        # fast-path metrics: every replica answered from query
+        assert all(
+            r.handlers.metrics.counters.get("readonly_served", 0) >= 1
+            for r in replicas
+        )
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_read_falls_back_to_ordered_read_when_a_replica_is_down():
+    """With one replica stopped the all-n fast quorum cannot form; the
+    client falls back to an ORDERED read: linearized by consensus,
+    executed via query — the ledger must not grow."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        await asyncio.wait_for(client.request(b"write-1"), 30)
+        await replicas[3].stop()  # a backup; 3/4 still orders
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True, read_timeout=0.3), 30
+        )
+        height = struct.unpack(">Q", head[:8])[0]
+        assert height == 1
+        assert head[8:] == ledgers[0].state_digest()
+        # the ordered read linearized WITHOUT mutating: length still 1 on
+        # the live replicas
+        await asyncio.sleep(0.2)
+        assert all(lg.length == 1 for lg in ledgers[:3]), [
+            lg.length for lg in ledgers
+        ]
+        await client.stop()
+        for r in replicas[:3]:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_read_requires_all_n_matching():
+    """A single diverging replica must defeat the fast read (the all-n
+    quorum is the correctness bound, not an implementation detail)."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        await asyncio.wait_for(client.request(b"write-1"), 30)
+        # replica 3's application state diverges (Byzantine or buggy)
+        await ledgers[3].deliver(b"phantom-write")
+        with pytest.raises(asyncio.TimeoutError):
+            await client.request(
+                b"head", read_only=True, read_timeout=0.3, read_fallback=False
+            )
+        # with fallback, the ordered read still answers — f+1 matching
+        # CORRECT replies outvote the diverged replica
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True, read_timeout=0.3), 30
+        )
+        assert head[8:] == ledgers[0].state_digest()
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_prepare_embedding_fast_read_is_rejected():
+    """A Byzantine primary batching a FAST read orders what the client
+    signed as unordered — backups must refuse the PREPARE."""
+
+    async def run():
+        from minbft_tpu.core import prepare as prepare_mod
+        from minbft_tpu.messages import UI, Prepare
+
+        async def ok_request(r):
+            return None
+
+        async def ok_ui(m):
+            return m.ui
+
+        validate = prepare_mod.make_prepare_validator(4, ok_request, ok_ui)
+        fast = Request(client_id=0, seq=1, operation=b"head", read_mode=1)
+        p = Prepare(replica_id=0, view=0, requests=(fast,), ui=UI(counter=1))
+        with pytest.raises(api.AuthenticationError, match="fast-read"):
+            await validate(p)
+        # an ORDERED read (the fallback) batches fine
+        ordered = Request(client_id=0, seq=2, operation=b"head", read_mode=2)
+        p2 = Prepare(
+            replica_id=0, view=0, requests=(ordered,), ui=UI(counter=2)
+        )
+        await validate(p2)
+        return True
+
+    assert asyncio.run(run())
