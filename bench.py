@@ -17,7 +17,8 @@ Environment knobs:
                             multi-process phase operating point
   MINBFT_BENCH_SLO_P50_MS   latency target for the *_at_p50_* runs (500)
   MINBFT_BENCH_SKIP_E2E / _SKIP_MP / _SKIP_NODEDUP / _SKIP_SLO /
-  _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519   phase gates
+  _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519 / _SKIP_RO   phase gates
+  MINBFT_BENCH_RO_READS     read-only phase size (default 4000)
   MINBFT_BENCH_SKIP_PREFLIGHT=1   skip the backend-retry pre-flight
   MINBFT_BENCH_CFG{1,2,4,5}_REQUESTS, _MAC_REQUESTS, _ISO_REQUESTS,
   _NODEDUP_REQUESTS, _NODEDUPREF_REQUESTS      per-config run lengths
@@ -892,6 +893,88 @@ async def _bench_cluster(
     }
 
 
+async def _bench_readonly(n=4, f=1, n_reads=4000, n_clients=16) -> dict:
+    """Read-only fast-path throughput (ecf541f): reads skip consensus —
+    one broadcast, n query replies, no PREPARE/COMMIT waves, no USIG —
+    so read throughput shows what the ordering pipeline costs writes.
+    Minimal in-process cluster, host crypto (reads never touch the
+    engine)."""
+    from minbft_tpu.client import new_client
+    from minbft_tpu.core import new_replica
+    from minbft_tpu.sample.authentication import new_test_authenticators
+    from minbft_tpu.sample.config import SimpleConfiger
+    from minbft_tpu.sample.conn.inprocess import (
+        InProcessClientConnector,
+        InProcessPeerConnector,
+        make_testnet_stubs,
+    )
+    from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+    cfg = SimpleConfiger(n=n, f=f, timeout_request=900.0, timeout_prepare=450.0)
+    r_auths, c_auths = new_test_authenticators(n, n_clients=n_clients)
+    stubs = make_testnet_stubs(n)
+    ledgers = [SimpleLedger() for _ in range(n)]
+    replicas = []
+    for i in range(n):
+        r = new_replica(i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i])
+        stubs[i].assign_replica(r)
+        replicas.append(r)
+    for r in replicas:
+        await r.start()
+    clients = []
+    for c in range(n_clients):
+        client = new_client(
+            c, n, f, c_auths[c], InProcessClientConnector(stubs), seq_start=0,
+            # Heal rare losses instead of wedging the phase (same rationale
+            # as _bench_cluster): the ordered-read fallback runs with no
+            # per-request deadline here.
+            retransmit_interval=30.0,
+        )
+        await client.start()
+        clients.append(client)
+    try:
+        await asyncio.wait_for(clients[0].request(b"write-1"), 240)
+        for _ in range(200):  # all n ledgers must agree before fast reads
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        if not all(lg.length == 1 for lg in ledgers):
+            # Proceeding would turn every fast read into a 30s all-n
+            # timeout + fallback: fail the phase fast instead.
+            raise RuntimeError(
+                f"cluster never agreed on the seed write: "
+                f"{[lg.length for lg in ledgers]}"
+            )
+        per = max(1, n_reads // n_clients)
+        n_reads = per * n_clients
+
+        async def reader(cl):
+            for _ in range(per):
+                await cl.request(b"head", read_only=True, read_timeout=30.0)
+
+        t0 = time.monotonic()
+        await asyncio.wait_for(
+            asyncio.gather(*(reader(cl) for cl in clients)), 600
+        )
+        elapsed = time.monotonic() - t0
+        fast_served = sum(
+            r.handlers.metrics.counters.get("readonly_served", 0)
+            for r in replicas
+        )
+        return {
+            "ro_reads": n_reads,
+            "ro_clients": n_clients,
+            "ro_reads_per_sec": round(n_reads / elapsed, 1),
+            # n * n_reads when every read took the fast path (no fallback)
+            "ro_fast_replies": fast_served,
+        }
+    finally:
+        for cl in clients:
+            await cl.stop()
+        for r in replicas:
+            await r.stop()
+
+
 def main() -> None:
     # Large batches amortize the per-dispatch overhead of remote-attached
     # chips (~13ms/launch on the tunneled bench host): measured 113k
@@ -962,6 +1045,19 @@ def main() -> None:
                 warm_run=True,
             )
         )
+    if not os.environ.get("MINBFT_BENCH_SKIP_RO"):
+        ro_reads = int(os.environ.get("MINBFT_BENCH_RO_READS", "4000"))
+        if jax.default_backend() == "cpu" and ro_reads > 400:
+            print("bench: CPU SIM clamps ro_reads to 400", file=sys.stderr, flush=True)
+            ro_reads = 400
+        try:
+            extras.update(asyncio.run(_bench_readonly(n_reads=ro_reads)))
+        except Exception as e:
+            print(
+                json.dumps({"ro_run": f"failed: {e}"[:300]}),
+                file=sys.stderr,
+                flush=True,
+            )
     if not os.environ.get("MINBFT_BENCH_SKIP_NODEDUP") and (
         jax.default_backend() != "cpu" or os.environ.get("MINBFT_BENCH_ALL_CONFIGS")
     ):
