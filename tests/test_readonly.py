@@ -172,3 +172,32 @@ def test_prepare_embedding_fast_read_is_rejected():
         return True
 
     assert asyncio.run(run())
+
+
+def test_reads_see_own_completed_writes():
+    """Session causality under interleaving: after a client's write
+    resolves, its next read must reflect that write — either the fast
+    path proves all n executed it, or the fallback linearizes the read
+    after it.  Exactly the committed count: this is the only writer, so
+    any other height is a lost or duplicated execution."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        for i in range(1, 6):
+            await asyncio.wait_for(client.request(b"write-%d" % i), 30)
+            head = await asyncio.wait_for(
+                client.request(b"head", read_only=True, read_timeout=0.5), 30
+            )
+            height = struct.unpack(">Q", head[:8])[0]
+            # exactly i: the sole client wrote i blocks, so >= would mask
+            # a duplicate-execution regression
+            assert height == i, (i, height)
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
