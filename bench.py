@@ -20,6 +20,7 @@ Environment knobs:
   _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519 / _SKIP_RO   phase gates
   MINBFT_BENCH_RO_READS     read-only phase size (default 4000)
   MINBFT_BENCH_SKIP_PREFLIGHT=1   skip the backend-retry pre-flight
+  MINBFT_BENCH_PREFLIGHT_ATTEMPTS backend probes before CPU re-exec (8)
   MINBFT_BENCH_CFG{1,2,4,5}_REQUESTS, _MAC_REQUESTS, _ISO_REQUESTS,
   _NODEDUP_REQUESTS, _NODEDUPREF_REQUESTS      per-config run lengths
 """
@@ -48,7 +49,7 @@ def _wait_for_backend() -> None:
     healthy backends (CPU included); skip with
     MINBFT_BENCH_SKIP_PREFLIGHT=1."""
     probe = "import jax; jax.devices()"
-    attempts = 8
+    attempts = int(os.environ.get("MINBFT_BENCH_PREFLIGHT_ATTEMPTS", "8"))
     for attempt in range(attempts):
         try:
             res = subprocess.run(
@@ -71,8 +72,34 @@ def _wait_for_backend() -> None:
         )
         if attempt + 1 < attempts:
             time.sleep(60)
+    # The accelerator never came up.  An honest CPU-backend artifact
+    # (backend key says "cpu", kernel rates collapse accordingly) beats a
+    # crashed bench that records NOTHING for the round.  RE-EXEC with a
+    # clean environment: merely setting JAX_PLATFORMS=cpu in-process is
+    # not enough — the accelerator plugin the site hook already
+    # registered can still wedge this interpreter on the dead tunnel
+    # (observed live), so the fallback must start over without it.
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        old = os.environ.get("JAX_PLATFORMS", "(default)")
+        print(
+            f"bench: backend {old} unavailable after {attempts} probes: "
+            "RE-EXEC ON CPU",
+            file=sys.stderr,
+            flush=True,
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.pathsep.join(
+            p
+            for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if "axon" not in p  # keep empty entries: "" means cwd
+        )
+        env["MINBFT_BENCH_SKIP_PREFLIGHT"] = "1"
+        env["MINBFT_BENCH_FALLBACK_FROM"] = old
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
+_BACKEND_FALLBACK = os.environ.get("MINBFT_BENCH_FALLBACK_FROM")
 if os.environ.get("MINBFT_BENCH_SKIP_PREFLIGHT") != "1":
     _wait_for_backend()
 
@@ -986,6 +1013,9 @@ def main() -> None:
     n_clients = int(os.environ.get("MINBFT_BENCH_CLIENTS", "100"))
 
     extras = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+    if _BACKEND_FALLBACK is not None:
+        # the intended accelerator backend was down; see stderr log
+        extras["backend_fallback_from"] = _BACKEND_FALLBACK
     if jax.default_backend() == "cpu":
         # SIM mode: keep shapes tiny so the bench still completes.
         batch = min(batch, 32)
