@@ -35,6 +35,13 @@ class AuthenticationError(Exception):
     """Tag failed to verify."""
 
 
+class ReadOnlyQueryError(Exception):
+    """A read-only request failed cluster-side: a reply-quorum of
+    replicas signed error replies (consumer lacks query() support, or
+    query() raised on the operation).  Distinguished from a timeout —
+    the cluster is healthy and answered; the READ is what failed."""
+
+
 class EmbeddedRequestAuthError(AuthenticationError):
     """A UI-certified proposal (PREPARE/COMMIT) embeds a REQUEST whose
     client authentication fails locally while the proposal's own UI is

@@ -76,6 +76,12 @@ class _PendingRequest:
         self.replies_by_replica: Dict[int, bytes] = {}
         self.count_by_digest: Dict[bytes, int] = {}
         self.result: asyncio.Future = loop.create_future()
+        # Pre-retrieve any exception outcome: an error quorum landing just
+        # after the awaiter timed out (and the pending was popped) must
+        # not log "Future exception was never retrieved" on GC.
+        self.result.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None
+        )
         # Marshaled REQUEST bytes, kept so a reconnecting replica stream can
         # re-send everything still unresolved (see _run_connection).
         self.data: Optional[bytes] = None
@@ -86,11 +92,23 @@ class _PendingRequest:
         if reply.replica_id in self.replies_by_replica:
             return  # one vote per replica (reference requestbuffer.go:219-236)
         self.replies_by_replica[reply.replica_id] = reply.result
-        digest = hashlib.sha256(reply.result).digest()
+        # The error flag is part of the vote: a signed error reply must
+        # never merge with a real empty result.
+        digest = hashlib.sha256(
+            (b"\x01" if reply.error else b"\x00") + reply.result
+        ).digest()
         cnt = self.count_by_digest.get(digest, 0) + 1
         self.count_by_digest[digest] = cnt
         if cnt >= self.threshold and not self.result.done():
-            self.result.set_result(reply.result)
+            if reply.error:
+                self.result.set_exception(
+                    api.ReadOnlyQueryError(
+                        "replica quorum signed error replies: query "
+                        "unsupported or raised on this operation"
+                    )
+                )
+            else:
+                self.result.set_result(reply.result)
 
 
 class Client:
@@ -316,7 +334,11 @@ class Client:
                 await self._inflight.acquire()
             try:
                 return await self._request_read_only(operation, ro_wait)
-            except asyncio.TimeoutError:
+            except (asyncio.TimeoutError, api.ReadOnlyQueryError):
+                # ReadOnlyQueryError: the fast quorum ANSWERED — with
+                # signed errors.  The ordered fallback usually fails the
+                # same way (it raises the typed error to the caller),
+                # but falling back is honest and costs one attempt.
                 if not read_fallback:
                     raise
             finally:

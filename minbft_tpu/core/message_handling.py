@@ -367,6 +367,8 @@ class Handlers:
             consumer,
             sign_message,
             add_reply,
+            log=self.log,
+            metrics=self.metrics,
         )
 
         # Checkpointing (phase 1 + 2 — core/checkpoint.py): every
@@ -1428,16 +1430,42 @@ class Handlers:
         if type(self.consumer).query is api.RequestConsumer.query:
             self.metrics.inc("readonly_unsupported")
             return None
-        result = await self.consumer.query(req.operation)
+        error = False
+        try:
+            result = await self.consumer.query(req.operation)
+        except NotImplementedError:
+            # A consumer that overrides query but refuses at runtime:
+            # answer a signed error (like the ordered path) so the client
+            # fails fast with the typed error instead of burning its
+            # read_timeout on an all-n quorum that can never form.
+            self.metrics.inc("readonly_unsupported")
+            error = True
+            result = b""
+        except Exception as e:
+            # The operation bytes are CLIENT-CONTROLLED: a consumer bug
+            # on crafted input must cost this read, not detonate in the
+            # stream processor as an internal error.  Answer a SIGNED
+            # error reply (one WARNING line, not a traceback — the log
+            # rate is attacker-chosen): an all-n error quorum raises
+            # ReadOnlyQueryError at the client without burning its
+            # read_timeout.
+            self.log.warning(
+                "read-only query failed: %r (op %r...)", e, req.operation[:32]
+            )
+            self.metrics.inc("readonly_query_errors")
+            error = True
+            result = b""
         reply = Reply(
             replica_id=self.replica_id,
             client_id=req.client_id,
             seq=req.seq,
             result=result,
             read_only=True,
+            error=error,
         )
         self.sign_message(reply)
-        self.metrics.inc("readonly_served")
+        if not error:
+            self.metrics.inc("readonly_served")
         return reply
 
     async def handle_peer_message(self, msg: Message) -> None:

@@ -90,6 +90,8 @@ def make_request_executor(
     consumer: api.RequestConsumer,
     sign_message,
     add_reply,
+    log=None,
+    metrics=None,
 ) -> Callable[[Request], Awaitable[None]]:
     """Execute a committed REQUEST exactly once (reference
     makeRequestExecutor, core/request.go:211-231): retire the seq (dedup),
@@ -108,6 +110,7 @@ def make_request_executor(
             return False  # already executed (reference request.go:214-218)
         pending_requests.remove(request)
         stop_timers(request)
+        error = False
         if request.is_read:
             # An ORDERED read (read_mode=2, the fast read's fallback):
             # consensus fixes its place in the order — that is the
@@ -116,15 +119,31 @@ def make_request_executor(
             # state -> same query result (also under log replay).
             try:
                 result = await consumer.query(request.operation)
-            except NotImplementedError:
-                # The deployment's consumer cannot serve reads (a
-                # type-level property, so uniform across replicas): send
-                # NO reply rather than agree on a fabricated b"" the
-                # client cannot distinguish from a real empty result —
-                # its request times out, the protocol's honest
-                # "unsupported" signal.  Bookkeeping above already ran,
-                # identically everywhere, so checkpoints stay aligned.
-                return True
+            except Exception as e:
+                # A SIGNED error reply on any query failure
+                # (NotImplementedError = the deployment cannot serve
+                # reads; anything else = a consumer bug on
+                # CLIENT-CONTROLLED operation bytes, which must not
+                # detonate in the execution chain behind committed
+                # writes).  NO reply would park every replica-side
+                # reply_for waiter on this seq forever — retransmissions
+                # then pile parked tasks onto the stream's bounded
+                # concurrency slots until the client's stream wedges.  A
+                # fabricated plain b"" would be indistinguishable from a
+                # real empty result; the error flag keeps it honest (the
+                # client raises ReadOnlyQueryError on an error quorum).
+                # State is untouched; checkpoint digests stay aligned
+                # even if the failure is replica-local.
+                error = True
+                result = b""
+                if log is not None:
+                    log.warning(
+                        "ordered read failed: %r (op %r...)",
+                        e,
+                        request.operation[:32],
+                    )
+                if metrics is not None:
+                    metrics.inc("readonly_query_errors")
         else:
             result = await consumer.deliver(request.operation)
         reply = Reply(
@@ -133,6 +152,7 @@ def make_request_executor(
             seq=request.seq,
             result=result,
             read_only=request.is_read,
+            error=error,
         )
         sign_message(reply)
         add_reply(reply)

@@ -86,6 +86,7 @@ def _authen_bytes(m: Message) -> bytes:
             + _U32.pack(m.client_id)
             + _U64.pack(m.seq)
             + bytes([1 if m.read_only else 0])
+            + bytes([1 if m.error else 0])
             + _sha256(m.result)
         )
     if isinstance(m, Prepare):

@@ -164,6 +164,7 @@ def marshal(m: Message) -> bytes:
             + _pack_u32(m.client_id)
             + _pack_u64(m.seq)
             + bytes([1 if m.read_only else 0])
+            + bytes([1 if m.error else 0])
             + _pack_bytes(m.result)
             + _pack_bytes(m.signature)
         )
@@ -345,7 +346,7 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
         cid, off = _read_u32(data, off)
         seq, off = _read_u64(data, off)
         rb, off = _read_bounded_byte(data, off, 1, "read_only flag")
-        ro = bool(rb)
+        eb, off = _read_bounded_byte(data, off, 1, "error flag")
         result, off = _read_bytes(data, off)
         sig, off = _read_bytes(data, off)
         return (
@@ -355,7 +356,8 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
                 seq=seq,
                 result=result,
                 signature=sig,
-                read_only=ro,
+                read_only=bool(rb),
+                error=bool(eb),
             ),
             off,
         )

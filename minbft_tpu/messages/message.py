@@ -119,6 +119,11 @@ class Reply(Message):
     # Marks a read-only fast-path answer; covered by the replica's
     # signature so an ordered reply cannot be replayed as a read.
     read_only: bool = False
+    # Signed failure signal for read-only requests (query unsupported or
+    # raised): a quorum of these resolves the client's request with a
+    # typed error instead of a fabricated result — and instead of NO
+    # reply, which would park the replica-side reply waiters forever.
+    error: bool = False
 
 
 @dataclasses.dataclass(init=False)

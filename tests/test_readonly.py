@@ -201,3 +201,63 @@ def test_reads_see_own_completed_writes():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_query_crash_costs_the_read_not_the_replica():
+    """consumer.query raising on crafted client input must neither crash
+    the fast path nor detonate the ordered execution chain: replicas
+    answer SIGNED error replies (silence would park reply waiters on the
+    bounded stream slots until the client's stream wedges), the client
+    raises the typed error fast, and writes keep committing."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        for lg in ledgers:
+            orig = lg.query
+
+            async def bomb(op, _orig=orig):
+                if op.startswith(b"crash"):
+                    raise ValueError("consumer bug on crafted input")
+                return await _orig(op)
+
+            lg.query = bomb
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        await asyncio.wait_for(client.request(b"write-1"), 30)
+        # fast path errors everywhere -> error quorum -> fallback ordered
+        # read errors everywhere -> typed error, well before any timeout
+        with pytest.raises(api.ReadOnlyQueryError):
+            await asyncio.wait_for(
+                client.request(b"crash-op", read_only=True, read_timeout=5.0),
+                20,
+            )
+        # error replies are distinguishable from REAL empty results: a
+        # query legitimately returning b"" still resolves
+        for lg in ledgers:
+            orig2 = lg.query
+
+            async def empty(op, _orig=orig2):
+                if op.startswith(b"empty"):
+                    return b""
+                return await _orig(op)
+
+            lg.query = empty
+        assert (
+            await asyncio.wait_for(
+                client.request(b"empty-op", read_only=True), 30
+            )
+            == b""
+        )
+        # the cluster survived: ordinary reads and writes still work
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True), 30
+        )
+        assert struct.unpack(">Q", head[:8])[0] == 1
+        assert await asyncio.wait_for(client.request(b"write-2"), 30)
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
