@@ -218,6 +218,12 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
     b.add_argument("--depth", type=int, default=8, help="pipelined requests per client")
     b.add_argument("--timeout", type=float, default=240.0, help="per-request deadline")
     b.add_argument(
+        "--read-only",
+        action="store_true",
+        help="drive read-only fast reads instead of ordered writes "
+        "(one seed write, then reads — measures the no-consensus path)",
+    )
+    b.add_argument(
         "--tag", default="", help="payload tag (keeps concurrent procs' ops distinct)"
     )
 
@@ -456,11 +462,31 @@ async def _run_bench_clients(args) -> int:
 
     latencies_ms: list = []
 
+    read_only = getattr(args, "read_only", False)
+
     async def timed(client, k: int) -> None:
         t = _time.time()
-        await asyncio.wait_for(
-            client.request(tag + b"-%d-%d" % (client.client_id, k)), args.timeout
-        )
+        if read_only:
+            # identical op bytes on purpose: reads have no dedup hazard,
+            # and identical results are exactly what the all-n fast
+            # quorum needs.  read_fallback=False: this mode MEASURES the
+            # no-consensus path — a degraded cluster (all-n quorum
+            # unreachable) must fail loudly, not silently report ordered
+            # consensus latencies as fast reads.
+            await asyncio.wait_for(
+                client.request(
+                    b"head",
+                    read_only=True,
+                    read_timeout=min(args.timeout, 30.0),
+                    read_fallback=False,
+                ),
+                args.timeout,
+            )
+        else:
+            await asyncio.wait_for(
+                client.request(tag + b"-%d-%d" % (client.client_id, k)),
+                args.timeout,
+            )
         latencies_ms.append(round((_time.time() - t) * 1e3, 2))
 
     async def drive(client) -> None:
