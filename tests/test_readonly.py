@@ -261,3 +261,55 @@ def test_query_crash_costs_the_read_not_the_replica():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_ordered_read_commits_through_a_view_change():
+    """A read issued while the primary is crashed: the fast all-n quorum
+    cannot form (the primary is dead), so the fallback ORDERED read rides
+    the view-change machinery like any request — timeout demands, NEW-VIEW,
+    commit in view 1 — and still mutates nothing."""
+
+    async def run():
+        from minbft_tpu.sample.config import SimpleConfiger
+
+        cfg = SimpleConfiger(
+            n=4, f=1,
+            timeout_request=0.8, timeout_prepare=0.4, timeout_viewchange=3.0,
+        )
+        replicas, c_auths, stubs, ledgers = await _cluster(cfg=cfg)
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        try:
+            assert await asyncio.wait_for(client.request(b"write-1"), 30)
+
+            stubs[0].crash()  # the view-0 primary
+            await replicas[0].stop()
+
+            head = await asyncio.wait_for(
+                client.request(b"head", read_only=True, read_timeout=0.3), 30
+            )
+            height = struct.unpack(">Q", head[:8])[0]
+            assert height == 1, height
+            # survivors moved to view >= 1 and the read mutated nothing
+            for r in replicas[1:]:
+                cur, _ = await r.handlers.view_state.hold_view()
+                assert cur >= 1, cur
+            # poll: the slowest survivor may still be executing write-1
+            # (quorums resolve at f+1 of 3)
+            for _ in range(100):
+                if all(lg.length == 1 for lg in ledgers[1:]):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(lg.length == 1 for lg in ledgers[1:]), [
+                lg.length for lg in ledgers[1:]
+            ]
+            # ordinary writes still work in the new view
+            assert await asyncio.wait_for(client.request(b"write-2"), 30)
+        finally:
+            await client.stop()
+            for r in replicas[1:]:
+                await r.stop()
+
+    asyncio.run(run())
