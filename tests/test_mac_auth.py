@@ -86,37 +86,44 @@ def test_key_views_are_minimal():
     assert not cview.replica_pair
 
 
+async def _mac_cluster(n=4, f=1):
+    """In-process cluster under pairwise-MAC authentication (the MAC-scheme
+    twin of conftest.make_cluster).  Returns (replicas, client, stubs,
+    ledgers); caller stops the client and replicas."""
+    from minbft_tpu.client import new_client
+    from minbft_tpu.core import new_replica
+    from minbft_tpu.sample.config import SimpleConfiger
+    from minbft_tpu.sample.conn.inprocess import (
+        InProcessClientConnector,
+        InProcessPeerConnector,
+        make_testnet_stubs,
+    )
+    from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+    cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
+    r_auths, c_auths = new_test_mac_authenticators(n, 1, usig_kind="hmac")
+    stubs = make_testnet_stubs(n)
+    ledgers = [SimpleLedger() for _ in range(n)]
+    replicas = []
+    for i in range(n):
+        r = new_replica(
+            i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
+        )
+        stubs[i].assign_replica(r)
+        replicas.append(r)
+    for r in replicas:
+        await r.start()
+    client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
+    await client.start()
+    return replicas, client, stubs, ledgers
+
+
 def test_cluster_commit_under_mac_scheme():
     """Full n=4 commit where REQUEST/REPLY authentication is MACs and the
     USIG path is unchanged."""
 
     async def run():
-        from minbft_tpu.client import new_client
-        from minbft_tpu.core import new_replica
-        from minbft_tpu.sample.config import SimpleConfiger
-        from minbft_tpu.sample.conn.inprocess import (
-            InProcessClientConnector,
-            InProcessPeerConnector,
-            make_testnet_stubs,
-        )
-        from minbft_tpu.sample.requestconsumer import SimpleLedger
-
-        n, f = 4, 1
-        cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
-        r_auths, c_auths = new_test_mac_authenticators(n, 1, usig_kind="hmac")
-        stubs = make_testnet_stubs(n)
-        ledgers = [SimpleLedger() for _ in range(n)]
-        replicas = []
-        for i in range(n):
-            r = new_replica(
-                i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
-            )
-            stubs[i].assign_replica(r)
-            replicas.append(r)
-        for r in replicas:
-            await r.start()
-        client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
-        await client.start()
+        replicas, client, stubs, ledgers = await _mac_cluster()
         assert await asyncio.wait_for(client.request(b"mac-op"), 60)
         for _ in range(200):
             if all(lg.length == 1 for lg in ledgers):
@@ -171,5 +178,35 @@ def test_unknown_principal_raises_auth_error():
             await r_auths[0].verify_message_authen_tag(
                 api.AuthenticationRole.CLIENT, 9999, b"m", tag
             )
+
+    asyncio.run(run())
+
+
+def test_fast_read_under_mac_scheme():
+    """Read-only fast path under pairwise-MAC authentication: reply MACs
+    are recipient-keyed, and the all-n quorum counts them like signatures."""
+
+    async def run():
+        import struct
+
+        replicas, client, stubs, ledgers = await _mac_cluster()
+        assert await asyncio.wait_for(client.request(b"mac-write"), 60)
+        for _ in range(200):
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        assert all(lg.length == 1 for lg in ledgers)
+        # read_fallback=False: a silent ordered fallback would pass every
+        # assertion without exercising the fast MAC reply path
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True, read_fallback=False,
+                           read_timeout=30.0),
+            60,
+        )
+        assert struct.unpack(">Q", head[:8])[0] == 1
+        assert all(lg.length == 1 for lg in ledgers)  # read mutated nothing
+        await client.stop()
+        for r in replicas:
+            await r.stop()
 
     asyncio.run(run())

@@ -313,3 +313,34 @@ def test_ordered_read_commits_through_a_view_change():
                 await r.stop()
 
     asyncio.run(run())
+
+
+def test_fast_read_under_ed25519_scheme():
+    """Scheme-independence: the read path signs/verifies replies like any
+    REPLY, so it must work under the Ed25519 scheme (cfg5's) too."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster(scheme="ed25519")
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        await asyncio.wait_for(client.request(b"write-1"), 60)
+        for _ in range(200):
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        assert all(lg.length == 1 for lg in ledgers)
+        # read_fallback=False: this pins the FAST path under the scheme —
+        # a silent ordered fallback would pass every assertion
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True, read_fallback=False,
+                           read_timeout=30.0),
+            60,
+        )
+        assert struct.unpack(">Q", head[:8])[0] == 1
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
