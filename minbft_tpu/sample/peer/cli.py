@@ -537,6 +537,7 @@ async def _run_bench_clients(args) -> int:
 async def _run_selftest(args) -> int:
     """In-process n=4/f=1 commit through generated keys + the dummy
     connector — a deployment smoke test needing no files or sockets."""
+    from ... import api
     from ...client import new_client
     from ...core import new_replica
     from ...sample.authentication import generate_testnet_keys
@@ -577,13 +578,36 @@ async def _run_selftest(args) -> int:
             break
         await asyncio.sleep(0.02)
     ok = all(lg.length == 1 for lg in ledgers)
+    read_ok = False
+    if ok:
+        # and the read-only fast path: strict (no ordered fallback) so a
+        # fast-quorum regression fails the selftest loudly — as the
+        # diagnostic line below, not an unhandled traceback
+        try:
+            head = await asyncio.wait_for(
+                client.request(
+                    b"head",
+                    read_only=True,
+                    read_fallback=False,
+                    read_timeout=30.0,
+                ),
+                60,
+            )
+        except (asyncio.TimeoutError, api.ReadOnlyQueryError):
+            head = b""
+        read_ok = bool(head) and head.endswith(ledgers[0].state_digest())
+        read_ok = read_ok and all(lg.length == 1 for lg in ledgers)
     await client.stop()
     for r in replicas:
         await r.stop()
     if not ok:
         print("selftest FAILED: not all ledgers committed", file=sys.stderr)
         return 1
-    print(f"selftest ok: request committed on all {n} replicas "
+    if not read_ok:
+        print("selftest FAILED: read-only fast path", file=sys.stderr)
+        return 1
+    print(f"selftest ok: request committed on all {n} replicas, "
+          f"fast read served "
           f"(usig={store.usig_spec}, result={result.hex()[:16]}…)", file=sys.stderr)
     return 0
 
