@@ -205,6 +205,12 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         help="read from committed state: fast path on all-n agreement, "
         "ordered-read fallback otherwise (mutates nothing either way)",
     )
+    q.add_argument(
+        "--no-read-fallback",
+        action="store_true",
+        help="with --read-only: fail instead of falling back to an "
+        "ordered read when the all-n fast quorum cannot form",
+    )
 
     b = sub.add_parser(
         "bench",
@@ -388,7 +394,12 @@ async def _run_request(args) -> int:
     try:
         for op in ops:
             result = await asyncio.wait_for(
-                client.request(op, read_only=getattr(args, "read_only", False)),
+                client.request(
+                    op,
+                    read_only=getattr(args, "read_only", False),
+                    read_fallback=not getattr(args, "no_read_fallback", False),
+                    read_timeout=min(args.timeout, 30.0),
+                ),
                 args.timeout,
             )
             print(result.hex())

@@ -73,6 +73,21 @@ def test_three_process_cluster_commits(tmp_path):
         assert req.returncode == 0, req.stderr
         assert len(req.stdout.strip()) == 64  # hex block digest
 
+        # read-only FAST path over the same sockets (no ordered
+        # fallback, or a fast-quorum regression would pass silently):
+        # height 1 + head digest matching the write's result above
+        ro = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "head", "--read-only", "--no-read-fallback",
+             "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert ro.returncode == 0, ro.stderr
+        head = ro.stdout.strip()
+        assert head[:16] == "0000000000000001", head
+        assert head[16:] == req.stdout.strip(), (head, req.stdout)
+
         # f=1: kill one backup, the cluster still commits
         replicas[2].terminate()
         replicas[2].wait(timeout=10)
