@@ -210,3 +210,56 @@ def test_wiped_replica_joins_via_state_transfer():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_checkpointing_stays_aligned_with_ordered_reads_interleaved():
+    """Ordered reads (read_mode=2) count toward the checkpoint period like
+    any delivered request — deterministically on every replica — but leave
+    state untouched.  Interleaving them with writes across checkpoint
+    boundaries must keep checkpoints stabilizing (digests agree: reads
+    mutate nothing) and GC truncating."""
+
+    async def scenario():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+
+        cfg = SimpleConfiger(
+            n=4, f=1, checkpoint_period=8,
+            timeout_request=60.0, timeout_prepare=30.0,
+        )
+        replicas, c_auths, stubs, ledgers = await make_cluster(n=4, f=1, cfg=cfg)
+        client = new_client(0, 4, 1, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        try:
+            for i in range(30):
+                await asyncio.wait_for(client.request(b"w-%d" % i), 30)
+                # read_timeout=0: wait_for(..., 0) times out before any
+                # reply can arrive, so the ORDERED fallback fires
+                # deterministically — every read crosses checkpoint
+                # boundaries as an execution
+                await asyncio.wait_for(
+                    client.request(b"head", read_only=True, read_timeout=0),
+                    30,
+                )
+            await asyncio.sleep(0.3)
+            digests = {lg.state_digest() for lg in ledgers}
+            assert len(digests) == 1, "replicas diverged"
+            assert all(lg.length == 30 for lg in ledgers), [
+                lg.length for lg in ledgers
+            ]
+            for r in replicas:
+                h = r.handlers
+                # exactly 60 executions (30 writes + 30 ordered reads) at
+                # period 8: checkpoints fired and GC ran
+                assert h.checkpoint_emitter.count == 60, h.checkpoint_emitter.count
+                assert h.metrics.counters.get("log_truncations", 0) > 0, (
+                    f"replica {r.id} never truncated"
+                )
+        finally:
+            await client.stop()
+            for r in replicas:
+                await r.stop()
+        return True
+
+    assert asyncio.run(scenario())
