@@ -19,6 +19,40 @@ async def _commit(client, count, tag=b"op"):
         assert r
 
 
+async def _joiner_cluster(cfg, n=4, f=1, offline=(3,)):
+    """Cluster with some replicas held OFFLINE (their auths/stubs/ledgers
+    exist so the test can start them later as late joiners).  TOFU
+    anchors, not pinned IDs: a deployed keystore captures peer epochs
+    trust-on-first-use — the capture-floor machinery both joiner tests
+    exist to pin (pinned IDs masked the round-5 deadlock).  Returns
+    (replicas, r_auths, c_auths, stubs, ledgers)."""
+    from minbft_tpu.core import new_replica
+    from minbft_tpu.sample.authentication import new_test_authenticators
+    from minbft_tpu.sample.conn.inprocess import (
+        InProcessPeerConnector,
+        make_testnet_stubs,
+    )
+    from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+    r_auths, c_auths = new_test_authenticators(
+        n, n_clients=1, usig_kind="hmac", tofu_anchors=True
+    )
+    stubs = make_testnet_stubs(n)
+    ledgers = [SimpleLedger() for _ in range(n)]
+    replicas = []
+    for i in range(n):
+        if i in offline:
+            continue
+        r = new_replica(
+            i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
+        )
+        stubs[i].assign_replica(r)
+        replicas.append(r)
+    for r in replicas:
+        await r.start()
+    return replicas, r_auths, c_auths, stubs, ledgers
+
+
 def test_log_stays_bounded_under_checkpointed_traffic():
     """With checkpoint_period=10, 150 serial requests leave every
     replica's broadcast log at O(window) — the covered prefix is dropped
@@ -122,39 +156,18 @@ def test_wiped_replica_joins_via_state_transfer():
     async def scenario():
         from minbft_tpu.client import new_client
         from minbft_tpu.core import new_replica
-        from minbft_tpu.sample.authentication import new_test_authenticators
         from minbft_tpu.sample.config import SimpleConfiger
         from minbft_tpu.sample.conn.inprocess import (
             InProcessClientConnector,
             InProcessPeerConnector,
-            make_testnet_stubs,
         )
-        from minbft_tpu.sample.requestconsumer import SimpleLedger
 
         n, f = 4, 1
         cfg = SimpleConfiger(
             n=n, f=f, checkpoint_period=10,
             timeout_request=60.0, timeout_prepare=30.0,
         )
-        # TOFU anchors, not pinned IDs: a deployed keystore captures peer
-        # epochs trust-on-first-use, and a late joiner whose peers
-        # truncated history can only establish them through the
-        # LOG-BASE-installed capture floor — the round-5 state-transfer
-        # deadlock this test must keep pinned (pinned IDs masked it).
-        r_auths, c_auths = new_test_authenticators(
-            n, n_clients=1, usig_kind="hmac", tofu_anchors=True
-        )
-        stubs = make_testnet_stubs(n)
-        ledgers = [SimpleLedger() for _ in range(n)]
-        replicas = []
-        for i in range(n - 1):  # replica 3 stays offline
-            r = new_replica(
-                i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i]
-            )
-            stubs[i].assign_replica(r)
-            replicas.append(r)
-        for r in replicas:
-            await r.start()
+        replicas, r_auths, c_auths, stubs, ledgers = await _joiner_cluster(cfg)
         client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
         await client.start()
         late = None
@@ -260,6 +273,68 @@ def test_checkpointing_stays_aligned_with_ordered_reads_interleaved():
             await client.stop()
             for r in replicas:
                 await r.stop()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_replay_joiner_reexecutes_ordered_reads():
+    """With NO checkpointing (no truncation), a late joiner catches up by
+    pure log replay — re-executing ordered reads at their slots via
+    query(), which must reproduce the same state digest (reads at the same
+    log position see the same state) and mutate nothing."""
+
+    async def scenario():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.core import new_replica
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import (
+            InProcessClientConnector,
+            InProcessPeerConnector,
+        )
+
+        n, f = 4, 1
+        cfg = SimpleConfiger(
+            n=n, f=f, timeout_request=60.0, timeout_prepare=30.0
+        )
+        replicas, r_auths, c_auths, stubs, ledgers = await _joiner_cluster(cfg)
+        client = new_client(0, n, f, c_auths[0], InProcessClientConnector(stubs))
+        await client.start()
+        late = None
+        try:
+            for i in range(10):
+                await asyncio.wait_for(client.request(b"w-%d" % i), 30)
+                # deterministic ORDERED read (see the interleaved test):
+                # lands in the log the joiner will replay
+                await asyncio.wait_for(
+                    client.request(b"head", read_only=True, read_timeout=0),
+                    30,
+                )
+
+            late = new_replica(
+                3, cfg, r_auths[3], InProcessPeerConnector(stubs), ledgers[3]
+            )
+            stubs[3].assign_replica(late)
+            await late.start()
+
+            # poll the EXECUTION counter (the last replayed entry is a
+            # read, which never bumps ledger length)
+            deadline = asyncio.get_running_loop().time() + 20
+            while asyncio.get_running_loop().time() < deadline:
+                if late.handlers.metrics.counters.get("requests_executed") == 20:
+                    break
+                await asyncio.sleep(0.05)
+            # replayed reads counted as executions on the joiner too
+            # (checkpoint alignment if GC is ever enabled): 20 total
+            assert late.handlers.metrics.counters.get("requests_executed") == 20
+            assert ledgers[3].length == 10, ledgers[3].length
+            assert ledgers[3].state_digest() == ledgers[0].state_digest()
+        finally:
+            await client.stop()
+            for r in replicas:
+                await r.stop()
+            if late is not None:
+                await late.stop()
         return True
 
     assert asyncio.run(scenario())
