@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Awaitable, Callable
 
 from .. import api
-from ..messages import Reply, Request, authen_bytes
+from ..messages import Reply, Request
 from . import utils
 
 

@@ -7,8 +7,6 @@ certified state transfer."""
 
 import asyncio
 
-import pytest
-
 from conftest import make_cluster
 from minbft_tpu.messages import ViewChange, marshal
 

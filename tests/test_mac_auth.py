@@ -8,7 +8,6 @@ import pytest
 
 from minbft_tpu import api
 from minbft_tpu.sample.authentication.mac import (
-    MacAuthenticator,
     generate_testnet_mac_keys,
     new_test_mac_authenticators,
 )
