@@ -162,6 +162,13 @@ class Client:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
         self._started = False
+        # Fail in-flight requests instead of leaving their callers parked
+        # on futures nothing will ever resolve.
+        for pending in list(self._pending.values()):
+            if not pending.result.done():
+                pending.result.set_exception(
+                    ConnectionError("client stopped with the request in flight")
+                )
 
     async def _outgoing(self, q: asyncio.Queue) -> AsyncIterator[bytes]:
         # Coalesce a pipelined burst of requests into one transport
@@ -333,6 +340,10 @@ class Client:
             if self._inflight is not None:
                 await self._inflight.acquire()
             try:
+                if not self._started:
+                    # stopped while parked on the semaphore: the sweep in
+                    # stop() already ran, so registering now would hang
+                    raise ConnectionError("client stopped")
                 return await self._request_read_only(operation, ro_wait)
             except (asyncio.TimeoutError, api.ReadOnlyQueryError):
                 # ReadOnlyQueryError: the fast quorum ANSWERED — with
@@ -359,6 +370,9 @@ class Client:
         if self._inflight is not None:
             await self._inflight.acquire()
         try:
+            if not self._started:
+                # stopped while parked on the semaphore (see stop())
+                raise ConnectionError("client stopped")
             self._seq += 1
             seq = self._seq
             req = Request(

@@ -336,3 +336,49 @@ def test_client_reply_verifier_outage_poisons_stream_but_never_severs():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_stop_fails_inflight_requests_instead_of_hanging():
+    """stop() must resolve in-flight requests with an error: their reply
+    streams are gone, so leaving the futures pending parks the callers
+    forever."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        conn = _LossyClientConnector(InProcessClientConnector(stubs), drop=10**9)
+        client = new_client(0, 4, 1, c_auths[0], conn, seq_start=0)
+        await client.start()
+        task = asyncio.ensure_future(client.request(b"never-answered"))
+        await asyncio.sleep(0.1)
+        await client.stop()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(task, 5)
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
+def test_stop_fails_requests_parked_on_the_inflight_semaphore():
+    """A caller that passed the started check but was parked on the
+    max_inflight semaphore when stop() swept the pending map must fail
+    fast too — registering after the sweep would hang forever."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        conn = _LossyClientConnector(InProcessClientConnector(stubs), drop=10**9)
+        client = new_client(0, 4, 1, c_auths[0], conn, seq_start=0, max_inflight=1)
+        await client.start()
+        t1 = asyncio.ensure_future(client.request(b"in-flight"))
+        await asyncio.sleep(0.05)
+        t2 = asyncio.ensure_future(client.request(b"parked-on-semaphore"))
+        await asyncio.sleep(0.05)
+        await client.stop()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(t1, 5)
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(t2, 5)
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
