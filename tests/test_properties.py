@@ -1,0 +1,149 @@
+"""Randomized property tests — the reference seeds its unit fixtures from
+random protocol parameters (core/testutils_test.go:24-70 randN 3..255,
+randView, randOtherReplicaID); these mirror that style over the invariants
+the arithmetic must hold for EVERY (n, f, view), not just the bench
+configs.
+"""
+
+import asyncio
+import random
+
+from minbft_tpu.core.commit import make_commitment_collector
+from minbft_tpu.core.utils import is_primary
+from minbft_tpu.messages import (
+    UI,
+    Prepare,
+    Reply,
+    Request,
+    authen_bytes,
+    marshal,
+    unmarshal,
+)
+from minbft_tpu.utils.backoff import ReconnectBackoff
+
+
+def _rand_nf(rng):
+    f = rng.randrange(1, 16)
+    n = 2 * f + 1 + rng.randrange(0, 4)  # n >= 2f+1
+    return n, f
+
+
+def test_exactly_one_primary_per_view():
+    rng = random.Random(0xB5)
+    for _ in range(200):
+        n, _ = _rand_nf(rng)
+        view = rng.randrange(0, 10_000)
+        primaries = [i for i in range(n) if is_primary(view, i, n)]
+        assert len(primaries) == 1
+        assert primaries[0] == view % n
+
+
+def test_primary_rotation_covers_every_replica():
+    rng = random.Random(0xB6)
+    for _ in range(50):
+        n, _ = _rand_nf(rng)
+        start = rng.randrange(0, 1000)
+        seen = set()
+        for view in range(start, start + n):
+            seen.update(i for i in range(n) if is_primary(view, i, n))
+        assert seen == set(range(n)), (n, start)
+
+
+def test_commit_quorum_fires_exactly_at_f_plus_1_for_random_f():
+    """The collector's quorum threshold, behaviorally, over random f
+    (the reference randomizes its fixtures the same way,
+    core/testutils_test.go:24-70): execution fires at the (f+1)-th
+    DISTINCT committer — never earlier, never again on extras or
+    duplicates."""
+
+    async def run():
+        rng = random.Random(0xB7)
+        for _ in range(25):
+            n, f = _rand_nf(rng)
+            executed = []
+
+            async def execute(request):
+                executed.append(request.seq)
+
+            collect = make_commitment_collector(f, execute)
+            req = Request(client_id=0, seq=1, operation=b"op")
+            p = Prepare(replica_id=0, view=0, request=req, ui=UI(counter=1))
+            committers = list(range(n))
+            rng.shuffle(committers)
+            # the primary's own PREPARE must be among the first f+1 votes
+            # (the collector counts it as a committer)
+            distinct = 0
+            for rid in committers:
+                dup = rng.random() < 0.3
+                await collect(rid, p)
+                distinct += 1
+                if dup:
+                    await collect(rid, p)  # duplicate vote: no effect
+                if distinct < f + 1:
+                    assert executed == [], (n, f, distinct)
+                else:
+                    assert executed == [1], (n, f, distinct)
+
+    asyncio.run(run())
+
+
+def test_request_authen_bytes_distinct_over_random_fields():
+    """Distinct (client, seq, op, read_mode) must never collide in authen
+    bytes — a collision would let one signature authorize another request."""
+    rng = random.Random(0xB8)
+    seen = {}
+    for _ in range(500):
+        r = Request(
+            client_id=rng.randrange(0, 1 << 16),
+            seq=rng.randrange(1, 1 << 48),
+            operation=bytes(rng.randrange(256) for _ in range(rng.randrange(0, 16))),
+            read_mode=rng.randrange(0, 3),
+        )
+        key = (r.client_id, r.seq, r.operation, r.read_mode)
+        ab = authen_bytes(r)
+        if key in seen:
+            assert seen[key] == ab
+        else:
+            for other_key, other_ab in seen.items():
+                assert other_ab != ab or other_key == key, (key, other_key)
+            seen[key] = ab
+
+
+def test_codec_roundtrip_random_request_reply():
+    rng = random.Random(0xB9)
+    for _ in range(300):
+        r = Request(
+            client_id=rng.randrange(0, 1 << 16),
+            seq=rng.randrange(1, 1 << 48),
+            operation=bytes(rng.randrange(256) for _ in range(rng.randrange(0, 32))),
+            signature=bytes(rng.randrange(256) for _ in range(rng.randrange(0, 8))),
+            read_mode=rng.randrange(0, 3),
+        )
+        assert unmarshal(marshal(r)) == r
+        p = Reply(
+            replica_id=rng.randrange(0, 64),
+            client_id=rng.randrange(0, 1 << 16),
+            seq=rng.randrange(1, 1 << 48),
+            result=bytes(rng.randrange(256) for _ in range(rng.randrange(0, 32))),
+            read_only=bool(rng.randrange(2)),
+            error=bool(rng.randrange(2)),
+        )
+        assert unmarshal(marshal(p)) == p
+
+
+def test_backoff_ladder_properties():
+    """For random parameters: delays are monotone non-decreasing up to the
+    cap while attempts die young, never exceed the cap, and reset to the
+    start after any lived connection."""
+    rng = random.Random(0xBA)
+    for _ in range(100):
+        start = rng.uniform(0.05, 1.0)
+        cap = start * rng.uniform(2.0, 50.0)
+        lived = rng.uniform(1.0, 10.0)
+        b = ReconnectBackoff(start_s=start, cap_s=cap, lived_reset_s=lived)
+        prev = 0.0
+        for _ in range(20):
+            d = b.next_delay(0.0)
+            assert prev <= d <= cap + 1e-9, (prev, d, cap)
+            prev = d
+        assert b.next_delay(lived + 0.1) == start
