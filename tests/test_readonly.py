@@ -344,3 +344,60 @@ def test_fast_read_under_ed25519_scheme():
             await r.stop()
 
     asyncio.run(run())
+
+
+def test_concurrent_reads_and_writes_storm():
+    """20 writers and 20 readers concurrently: writes execute exactly
+    once, and EVERY read result is a (height, digest) the chain really
+    passed through — a fabricated or torn read would name a digest that
+    never existed at that height."""
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster(n_clients=2)
+        writer = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        reader = new_client(
+            1, 4, 1, c_auths[1], InProcessClientConnector(stubs), seq_start=0
+        )
+        await writer.start()
+        await reader.start()
+        reads: list = []
+
+        async def write(i):
+            await writer.request(b"w-%d" % i)
+
+        async def read(i):
+            # fallback allowed: under concurrent writes the all-n quorum
+            # legitimately fails whenever a write is mid-execution
+            reads.append(
+                await reader.request(b"head", read_only=True, read_timeout=0.5)
+            )
+
+        await asyncio.wait_for(
+            asyncio.gather(
+                *(write(i) for i in range(20)), *(read(i) for i in range(20))
+            ),
+            60,
+        )
+        for _ in range(100):
+            if all(lg.length == 20 for lg in ledgers):
+                break
+            await asyncio.sleep(0.05)
+        assert all(lg.length == 20 for lg in ledgers), [
+            lg.length for lg in ledgers
+        ]
+        assert len(reads) == 20
+        for res in reads:
+            height = struct.unpack(">Q", res[:8])[0]
+            assert 0 <= height <= 20, height
+            blk = ledgers[0].block(height)
+            assert blk is not None and blk.digest() == res[8:], (
+                "read named a digest the chain never had at that height"
+            )
+        await writer.stop()
+        await reader.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
