@@ -316,3 +316,78 @@ def test_cluster_gc_soak_pipelined():
         return True
 
     assert asyncio.run(run())
+
+
+def test_chaos_reconnect_soak_pipelined():
+    """Pipelined traffic while EVERY client stream dies after each 25
+    delivered frames, with reads mixed in: the redial loop's queue swap +
+    pending re-send must hold up under sustained load without losing,
+    duplicating, or wedging anything.  MINBFT_CHAOS_REQUESTS scales it up
+    outside CI (default 600: ~3s)."""
+
+    async def run():
+        import os
+        import struct
+
+        from minbft_tpu.client import new_client
+        from test_client_robustness import _ChaosClientConnector
+        from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+        from conftest import make_cluster
+
+        n_requests = int(os.environ.get("MINBFT_CHAOS_REQUESTS", "600"))
+        n_clients = 6
+        replicas, c_auths, stubs, ledgers = await make_cluster(
+            n_clients=n_clients
+        )
+        clients = []
+        conns = []
+        for c in range(n_clients):
+            conn = _ChaosClientConnector(InProcessClientConnector(stubs), 25)
+            conns.append(conn)
+            cl = new_client(
+                c, 4, 1, c_auths[c], conn, seq_start=0, max_inflight=8
+            )
+            await cl.start()
+            clients.append(cl)
+
+        per_client = n_requests // n_clients
+
+        async def drive(cl):
+            depth = 8  # real pipelining: several writes pending per drop
+            for k0 in range(0, per_client, depth):
+                await asyncio.gather(
+                    *[
+                        asyncio.wait_for(cl.request(b"c%d" % k), 120)
+                        for k in range(k0, min(k0 + depth, per_client))
+                    ]
+                )
+                # a read rides the same flaky streams after each window;
+                # the client completed k0+depth writes, so its own-session
+                # floor is AT LEAST that many blocks (others add more)
+                done = min(k0 + depth, per_client)
+                head = await asyncio.wait_for(
+                    cl.request(b"head", read_only=True, read_timeout=0.5),
+                    120,
+                )
+                assert struct.unpack(">Q", head[:8])[0] >= done
+        try:
+            await asyncio.gather(*[drive(cl) for cl in clients])
+            total = per_client * n_clients
+            for _ in range(400):
+                if all(lg.length == total for lg in ledgers):
+                    break
+                await asyncio.sleep(0.05)
+            # exactly-once: chaos re-sends never duplicate an execution
+            assert all(lg.length == total for lg in ledgers), [
+                lg.length for lg in ledgers
+            ]
+            assert len({lg.state_digest() for lg in ledgers}) == 1
+            assert all(c.drops > 0 for c in conns), [c.drops for c in conns]
+        finally:
+            for cl in clients:
+                await cl.stop()
+            for r in replicas:
+                await r.stop()
+        return True
+
+    assert asyncio.run(run())
