@@ -2,9 +2,15 @@
 # build + usig-check + `go test -short -race ./...`; lint = golangci-lint).
 #
 #   make native      build the native C++ USIG module (+ its C++ unit test)
-#   make lint        byte-compile every source file (the no-new-deps linter
-#                    tier: catches syntax/undefined-name-level rot) + a
-#                    pyflakes pass when available
+#   make lint        three-layer lint tier: (1) compileall byte-compiles
+#                    every source file (syntax/undefined-name rot, zero
+#                    deps); (2) `python -m tools.analyze` runs the
+#                    project-aware invariant passes — lock discipline,
+#                    JAX trace purity, message-kind exhaustiveness, secret
+#                    hygiene, dead code (tools/analyze/README.md; the
+#                    `go test -race` + golangci-lint analogue of the
+#                    reference); (3) ruff (preferred, [tool.ruff] in
+#                    pyproject.toml) or pyflakes when installed
 #   make fast        native + lint + the unit tier of the test suite (<2min)
 #   make check       native + lint + the FULL test suite (~9min, what CI runs)
 #   make bench       the driver's bench entry point (real TPU)
@@ -18,16 +24,20 @@ PY ?= python
 native:
 	$(MAKE) -C minbft_tpu/native
 
-# The image has no dedicated Python linter baked in; compileall is the
-# always-available floor, pyflakes layers on when present.  The presence
-# check is separate from the run so a real pyflakes FAILURE fails the
-# target (an `a && b || c` chain would swallow it).
+# compileall is the always-available floor; tools/analyze hard-fails on
+# any non-baselined finding of its five passes; ruff/pyflakes layer on
+# when present.  The presence check is separate from the run so a real
+# linter FAILURE fails the target (an `a && b || c` chain would swallow
+# it).
 lint:
 	$(PY) -m compileall -q minbft_tpu tests bench.py __graft_entry__.py
-	@if $(PY) -c "import pyflakes" 2>/dev/null; then \
-	    $(PY) -m pyflakes minbft_tpu bench.py __graft_entry__.py; \
+	$(PY) -m tools.analyze
+	@if $(PY) -c "import ruff" 2>/dev/null; then \
+	    $(PY) -m ruff check minbft_tpu tests bench.py __graft_entry__.py; \
+	elif $(PY) -c "import pyflakes" 2>/dev/null; then \
+	    $(PY) -m pyflakes minbft_tpu tests bench.py __graft_entry__.py; \
 	else \
-	    echo "pyflakes not installed; compileall-only lint"; \
+	    echo "ruff/pyflakes not installed; tools/analyze dead-code pass is the floor"; \
 	fi
 
 # Unit tier: everything except the multi-process / deploy / soak suites —

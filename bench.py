@@ -787,8 +787,6 @@ async def _bench_cluster(
         for b in shared.buckets:
             await asyncio.to_thread(dispatch, [pad_item] * b)
     if scheme == "ed25519":
-        from minbft_tpu.ops import ed25519 as _ed
-
         shared._queue("ed25519", shared._dispatch_ed25519)
         for b in shared.buckets:
             await asyncio.to_thread(shared._dispatch_ed25519, [(b"\x00" * 32, b"", b"\x00" * 64)] * b)

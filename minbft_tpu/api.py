@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import abc
 import enum
-from typing import AsyncIterator, Awaitable, Callable, Optional, Sequence
+from typing import AsyncIterator, Awaitable, Optional
 
 
 class AuthenticationRole(enum.Enum):

@@ -97,7 +97,10 @@ class MessageLog:
             if done is not None and done.is_set():
                 return
             ev = asyncio.Event()
-            self._waiters.append(ev)
+            # Atomic loop-side registration; the re-check below (and the
+            # drain-either-way continue) absorbs an append/truncate racing
+            # this suspension point.
+            self._waiters.append(ev)  # noqa: LD001
             if idx - self._seq0 < len(self._entries) or idx < self._seq0:
                 # An append/truncate raced our registration; the event may
                 # stay set or unset — loop and drain either way.

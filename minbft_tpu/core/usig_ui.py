@@ -13,7 +13,7 @@ serially under the processing goroutine).
 
 from __future__ import annotations
 
-from typing import Awaitable, Callable, Optional, Tuple
+from typing import Awaitable, Callable
 
 from .. import api
 from ..messages import UI, Message, authen_bytes

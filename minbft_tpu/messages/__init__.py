@@ -62,6 +62,9 @@ __all__ = [
     "is_peer_message",
     "marshal",
     "unmarshal",
+    "pack_multi",
+    "split_multi",
+    "drain_multi",
     "CodecError",
     "authen_bytes",
     "authen_digest",

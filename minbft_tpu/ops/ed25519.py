@@ -41,7 +41,6 @@ from .limbs import (
     fe_const,
     fe_eq,
     fe_from_array,
-    fe_select,
     from_mont,
     mont_inv,
     mont_mul,
@@ -131,7 +130,6 @@ def _ladder(u1_arr: jnp.ndarray, u2_arr: jnp.ndarray, aq: EdPoint) -> EdPoint:
     additions: table index 0 is the identity, so every iteration is
     double-then-add with a 4-way table select and no branches at all."""
     one = mont_one(FIELD)
-    zero = limbs.fe_zero()
     bpt = EdPoint(_BX_M, _BY_M, one, _BT_M)
     ba = _add(bpt, aq)  # B + A'
 

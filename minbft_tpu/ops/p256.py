@@ -50,7 +50,6 @@ from .limbs import (
     fe_from_array,
     fe_is_zero,
     fe_select,
-    from_limbs,
     mont_inv,
     mont_mul,
     mont_one,

@@ -212,10 +212,13 @@ class _SchemeQueue:
             for fut in self._inflight_futs.pop(it, ()):
                 if not fut.done():
                     fut.set_result(ok)
+        # Loop-confined trims: each popitem is atomic on the event loop
+        # and the while re-checks after every one, so interleaving with a
+        # concurrent _run only trims more — no cross-await invariant.
         while len(self._memo) > self._MEMO_CAP:
-            self._memo.popitem(last=False)
+            self._memo.popitem(last=False)  # noqa: LD001
         while len(self._neg_memo) > self._NEG_MEMO_CAP:
-            self._neg_memo.popitem(last=False)
+            self._neg_memo.popitem(last=False)  # noqa: LD001
 
     async def _dispatch_with_fallback(self, items):
         """Run the dispatcher with a liveness net: on remote-attached
@@ -350,6 +353,13 @@ class BatchVerifier:
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         self._sharded_kernels: Dict[str, object] = {}
         self._sharded_lock = threading.Lock()
+        # Stats fields are owned per-field: the event loop owns the counts
+        # _run updates; padded_lanes is updated by the DISPATCHER, which
+        # runs on a worker thread (asyncio.to_thread) — and max_inflight
+        # of them can race the read-modify-write.  All padded_lanes
+        # updates go through this lock (tools/analyze lock-discipline
+        # enforces it).
+        self._stats_lock = threading.Lock()
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.max_inflight = max_inflight
@@ -403,7 +413,10 @@ class BatchVerifier:
         q = self._queues.get(name)
         if q is None:
             q = _SchemeQueue(self, name, dispatch)
-            self._queues[name] = q
+            # Loop-side publish of a fresh queue: a GIL-atomic dict store;
+            # worker threads only ever read entries that existed before
+            # their dispatch was scheduled.
+            self._queues[name] = q  # noqa: LD001
         return q
 
     def _host_fallback_for(self, name: str):
@@ -491,7 +504,8 @@ class BatchVerifier:
         packed = p256.pack_arrays(
             p256.prepare_batch(list(items) + [_ECDSA_PAD] * (b - n))
         )
-        self._queues["ecdsa_p256"].stats.padded_lanes += b - n
+        with self._stats_lock:
+            self._queues["ecdsa_p256"].stats.padded_lanes += b - n
         if self.mesh is not None:
             from . import mesh as mesh_mod
 
@@ -512,7 +526,8 @@ class BatchVerifier:
             packed[i, 0:8] = np.frombuffer(key, dtype=">u4").astype(np.uint32)
             packed[i, 8:16] = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
             packed[i, 16:24] = np.frombuffer(mac, dtype=">u4").astype(np.uint32)
-        self._queues["hmac_sha256"].stats.padded_lanes += b - n
+        with self._stats_lock:
+            self._queues["hmac_sha256"].stats.padded_lanes += b - n
         if self.mesh is not None:
             from . import mesh as mesh_mod
 
@@ -526,7 +541,8 @@ class BatchVerifier:
 
         n = len(items)
         b = _bucket_for(n, self.buckets)
-        self._queues["ed25519"].stats.padded_lanes += b - n
+        with self._stats_lock:
+            self._queues["ed25519"].stats.padded_lanes += b - n
         if self.mesh is not None:
             from . import mesh as mesh_mod
 

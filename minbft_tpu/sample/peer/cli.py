@@ -68,23 +68,23 @@ def load_peer_options(path: str, explicit: bool) -> dict:
                 f"got {type(v).__name__}"
             )
 
-    for key, val in data.items():
-        if key in _PEER_OPTION_SCHEMA[None]:
-            check_scalar(key, val)
+    for opt, val in data.items():
+        if opt in _PEER_OPTION_SCHEMA[None]:
+            check_scalar(opt, val)
             continue
-        sub = _PEER_OPTION_SCHEMA.get(key)
+        sub = _PEER_OPTION_SCHEMA.get(opt)
         if sub is None:
-            raise SystemExit(f"peer: unknown option {key!r} in {path!r}")
+            raise SystemExit(f"peer: unknown option {opt!r} in {path!r}")
         if not isinstance(val, dict):
             raise SystemExit(
-                f"peer: section {key!r} in {path!r} must be a mapping"
+                f"peer: section {opt!r} in {path!r} must be a mapping"
             )
-        for k, v in val.items():
-            if k not in sub:
+        for sub_opt, v in val.items():
+            if sub_opt not in sub:
                 raise SystemExit(
-                    f"peer: unknown option {key}.{k!r} in {path!r}"
+                    f"peer: unknown option {opt}.{sub_opt!r} in {path!r}"
                 )
-            check_scalar(f"{key}.{k}", v)
+            check_scalar(f"{opt}.{sub_opt}", v)
     return data
 
 

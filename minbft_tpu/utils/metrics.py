@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 
 @dataclasses.dataclass

@@ -27,8 +27,6 @@ import os
 import secrets
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
 KDF = "pbkdf2-sha256"
 ITERATIONS = 60_000  # one derivation per keystore FILE, not per field
 SALT_LEN = 16
@@ -61,17 +59,35 @@ def derive_key(secret: bytes, salt: bytes, iterations: int = ITERATIONS) -> byte
     return hashlib.pbkdf2_hmac("sha256", secret, salt, iterations, dklen=32)
 
 
+def _aesgcm(key: bytes):
+    """AESGCM gated behind actual use: sealing is an OPT-IN feature (no
+    MINBFT_SEAL_SECRET -> plaintext fields, 0600 perms), and the bare
+    jax_graft image ships without the cryptography package — importing it
+    at module load would take the whole keystore down for unsealed
+    deployments too."""
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError as e:
+        raise SealError(
+            "keystore sealing requires the 'cryptography' package "
+            "(unset MINBFT_SEAL_SECRET / _FILE to run unsealed)"
+        ) from e
+    return AESGCM(key)
+
+
 def box(plain: bytes, key: bytes) -> bytes:
     """nonce(12) || AES-256-GCM(ciphertext || tag16)."""
     nonce = secrets.token_bytes(NONCE_LEN)
-    return nonce + AESGCM(key).encrypt(nonce, plain, b"")
+    return nonce + _aesgcm(key).encrypt(nonce, plain, b"")
 
 
 def unbox(blob: bytes, key: bytes) -> bytes:
     if len(blob) < NONCE_LEN + 16:
         raise SealError("sealed blob too short")
     try:
-        return AESGCM(key).decrypt(blob[:NONCE_LEN], blob[NONCE_LEN:], b"")
+        return _aesgcm(key).decrypt(blob[:NONCE_LEN], blob[NONCE_LEN:], b"")
+    except SealError:
+        raise
     except Exception as e:
         raise SealError(
             "sealed blob failed to decrypt (wrong secret or corrupted data)"

@@ -1,0 +1,226 @@
+"""Framework-level tests for tools.analyze: suppressions, baseline
+round-trip (add finding -> baseline -> suppressed -> fix -> stale), CLI
+exit codes, and pass registration."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from tools.analyze import Baseline, Finding, Project, all_passes, run_passes
+from tools.analyze.core import is_suppressed
+from tools.analyze.project import (
+    AnalyzeConfig,
+    DeadCodeConfig,
+    SecretHygieneConfig,
+    TracePurityConfig,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make_config(**kw):
+    """A minimal config: everything off unless a fixture opts in."""
+    defaults = dict(
+        source_roots=("src",),
+        lock_classes=(),
+        trace=TracePurityConfig(roots=()),
+        exhaustiveness=None,
+        secrets=SecretHygieneConfig(roots=()),
+        dead=DeadCodeConfig(roots=()),
+    )
+    defaults.update(kw)
+    return AnalyzeConfig(**defaults)
+
+
+def write_tree(root: Path, files: dict) -> None:
+    for rel, content in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+
+
+def test_all_four_project_passes_registered():
+    passes = all_passes()
+    prefixes = {cls.code_prefix for cls in passes.values()}
+    assert {"LD", "TP", "EX", "SH", "DC"} <= prefixes
+
+
+def test_noqa_suppresses_same_line_and_line_above(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "src/m.py": (
+                "import os  # noqa: DC401\n"
+                "# noqa: DC401\n"
+                "import sys\n"
+                "import json\n"
+            )
+        },
+    )
+    cfg = make_config(dead=DeadCodeConfig(roots=("src",)))
+    project = Project(tmp_path, config=cfg)
+    findings = run_passes(project, select=["dead-code"])
+    # os (inline noqa) and sys (standalone noqa above) suppressed; json not
+    assert [f.message for f in findings] == ["unused import json"]
+
+
+def test_bare_noqa_suppresses_all_codes(tmp_path):
+    write_tree(tmp_path, {"src/m.py": "import os  # noqa\n"})
+    cfg = make_config(dead=DeadCodeConfig(roots=("src",)))
+    assert run_passes(Project(tmp_path, config=cfg), select=["dead-code"]) == []
+
+
+def test_noqa_with_other_code_does_not_suppress(tmp_path):
+    write_tree(tmp_path, {"src/m.py": "import os  # noqa: LD001\n"})
+    cfg = make_config(dead=DeadCodeConfig(roots=("src",)))
+    findings = run_passes(Project(tmp_path, config=cfg), select=["dead-code"])
+    assert len(findings) == 1
+
+
+def test_is_suppressed_out_of_range_line(tmp_path):
+    write_tree(tmp_path, {"src/m.py": "x = 1\n"})
+    project = Project(tmp_path, config=make_config())
+    assert not is_suppressed(project, Finding("XX001", "src/m.py", 99, "m"))
+
+
+def test_fingerprint_excludes_line_number():
+    a = Finding("DC401", "src/m.py", 3, "unused import os")
+    b = Finding("DC401", "src/m.py", 30, "unused import os")
+    assert a.fingerprint == b.fingerprint
+
+
+def test_baseline_round_trip(tmp_path):
+    """The satellite-task contract: add finding -> write baseline ->
+    suppressed -> fix the finding -> the baseline entry reports stale."""
+    src = tmp_path / "src" / "m.py"
+    write_tree(tmp_path, {"src/m.py": "import os\n"})
+    cfg = make_config(dead=DeadCodeConfig(roots=("src",)))
+
+    findings = run_passes(Project(tmp_path, config=cfg), select=["dead-code"])
+    assert len(findings) == 1
+
+    bl_path = tmp_path / "baseline.json"
+    Baseline.from_findings(findings).save(bl_path)
+    entries = json.loads(bl_path.read_text())["findings"]
+    assert len(entries) == 1
+    (entry,) = entries.values()
+    assert entry["count"] == 1
+    assert entry["justification"]  # never silently empty
+
+    # baselined -> suppressed
+    reported, suppressed, stale = Baseline.load(bl_path).apply(findings)
+    assert reported == [] and len(suppressed) == 1 and stale == []
+
+    # a SECOND instance of the same fingerprint exceeds the budget
+    dup = findings + findings
+    reported, suppressed, stale = Baseline.load(bl_path).apply(dup)
+    assert len(reported) == 1 and len(suppressed) == 1
+
+    # surplus budget is stale too: fixing one of N baselined instances
+    # must be detected, or the leftover budget would silently absorb the
+    # next regression of the same pattern
+    surplus = Baseline(
+        {findings[0].fingerprint: {"count": 3, "justification": "x"}}
+    )
+    reported, suppressed, stale = surplus.apply(findings)
+    assert reported == [] and len(suppressed) == 1
+    assert stale == [findings[0].fingerprint]
+
+    # fix the finding -> entry is stale
+    src.write_text("import os\nprint(os.sep)\n")
+    project = Project(tmp_path, config=cfg)  # fresh AST cache
+    findings = run_passes(project, select=["dead-code"])
+    assert findings == []
+    reported, suppressed, stale = Baseline.load(bl_path).apply(findings)
+    assert reported == [] and suppressed == []
+    assert len(stale) == 1 and "DC401" in stale[0]
+
+
+def test_baseline_keeps_justification_on_regeneration(tmp_path):
+    f = Finding("DC401", "src/m.py", 1, "unused import os")
+    bl = Baseline.from_findings([f])
+    bl.entries[f.fingerprint]["justification"] = "kept for the demo"
+    bl2 = Baseline.from_findings([f, f], old=bl)
+    assert bl2.entries[f.fingerprint]["justification"] == "kept for the demo"
+    assert bl2.entries[f.fingerprint]["count"] == 2
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analyze", *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_cli_on_this_repo_is_clean_and_fails_on_seeded_violation(tmp_path):
+    """Acceptance pin: `make lint`'s analyzer step exits 0 on the repo as
+    committed, and non-zero once a violation of each pass is seeded."""
+    clean = _run_cli([], REPO)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    # Seed one violation per pass in a scratch tree via --root + the test
+    # config is not reachable from the CLI, so seed into a COPY of the
+    # default layout: cheapest is a dead import in a new file under
+    # tests/ … but that would dirty the repo.  Instead: a fixture root
+    # exercising the dead-code pass end-to-end through the CLI.
+    write_tree(
+        tmp_path,
+        {
+            "tools/analyze/placeholder.txt": "",
+            "minbft_tpu/bad.py": "import os\n",
+        },
+    )
+    seeded = _run_cli(["--root", str(tmp_path)], REPO)
+    assert seeded.returncode == 1
+    assert "DC401" in seeded.stdout
+
+
+def test_cli_write_baseline_refuses_partial_select(tmp_path):
+    # A partial run writing the baseline would destroy the other passes'
+    # grandfathered entries.
+    res = _run_cli(
+        ["--select", "dead-code", "--write-baseline", "--baseline",
+         str(tmp_path / "bl.json")],
+        REPO,
+    )
+    assert res.returncode == 2
+    assert "full run" in res.stderr
+
+
+def test_cli_list_passes():
+    out = _run_cli(["--list-passes"], REPO)
+    assert out.returncode == 0
+    for name in (
+        "lock-discipline",
+        "trace-purity",
+        "exhaustiveness",
+        "secret-hygiene",
+        "dead-code",
+    ):
+        assert name in out.stdout
+
+
+def test_cli_stale_baseline_fails_and_allow_stale_passes(tmp_path):
+    # The repo itself is clean, so a baseline naming a long-gone finding
+    # is pure staleness: an error by default, tolerated with --allow-stale.
+    bl = tmp_path / "baseline.json"
+    bl.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "findings": {
+                    "DC401:minbft_tpu/gone.py:unused import os": {
+                        "count": 1,
+                        "justification": "was grandfathered",
+                    }
+                },
+            }
+        )
+    )
+    res = _run_cli(["--baseline", str(bl)], REPO)
+    assert res.returncode == 1 and "STALE" in res.stdout
+    res = _run_cli(["--baseline", str(bl), "--allow-stale"], REPO)
+    assert res.returncode == 0

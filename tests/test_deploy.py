@@ -12,7 +12,6 @@ executed, not just shipped.
 
 import os
 import subprocess
-import sys
 
 import yaml
 

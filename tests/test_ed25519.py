@@ -3,8 +3,6 @@ reference verifier (valid, tampered, wrong-key, malformed, non-canonical)."""
 
 import hashlib
 
-import pytest
-
 from minbft_tpu.ops import ed25519 as ed
 from minbft_tpu.utils import hostcrypto as hc
 

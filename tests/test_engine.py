@@ -7,10 +7,7 @@ import hashlib
 import hmac as hmac_mod
 import time
 
-import pytest
-
 from minbft_tpu.parallel import BatchVerifier
-from minbft_tpu.usig.software import _signed_payload
 
 
 def _hmac_item(i: int, valid: bool = True):
@@ -69,7 +66,6 @@ def test_full_batch_flushes_immediately():
 def test_cluster_with_batching_engine():
     """n=3 cluster where every replica routes verification through its own
     BatchVerifier (HMAC USIG; CPU SIM mode)."""
-    import tests.test_integration as ti
     from minbft_tpu.client import new_client
     from minbft_tpu.core import new_replica
     from minbft_tpu.sample.authentication import new_test_authenticators
@@ -269,3 +265,39 @@ def test_first_dispatch_gets_cold_compile_headroom():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_padded_lane_accounting_is_thread_safe():
+    """Regression pin for the padded_lanes data race: dispatchers run on
+    worker threads (up to max_inflight concurrently) and used to do a bare
+    read-modify-write on the shared stats counter — two racing dispatches
+    could lose an increment.  All padded-lane accounting now goes through
+    BatchVerifier._stats_lock (enforced by the tools/analyze
+    lock-discipline pass), so N concurrent single-item dispatches into
+    bucket size B must count EXACTLY N*(B-1) padded lanes."""
+    import threading
+
+    eng = BatchVerifier(max_batch=8, buckets=(8,))
+    # Materialize the queue (dispatchers update its stats slot directly)
+    # and warm the kernel so the threads race on accounting, not compile.
+    eng._queue("hmac_sha256", eng._dispatch_hmac)
+    eng._dispatch_hmac([_hmac_item(0)])
+    base = eng.stats["hmac_sha256"].padded_lanes
+    n_threads, per_thread = 8, 4
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid: int) -> None:
+        barrier.wait()
+        for j in range(per_thread):
+            res = eng._dispatch_hmac([_hmac_item(100 + tid * per_thread + j)])
+            assert bool(res[0])
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = eng.stats["hmac_sha256"].padded_lanes - base
+    assert got == n_threads * per_thread * 7  # bucket 8, batch 1 -> 7 pads

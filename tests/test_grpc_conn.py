@@ -5,8 +5,6 @@ a full n=3 cluster committing requests over real sockets.
 
 import asyncio
 
-import pytest
-
 from minbft_tpu import api
 from minbft_tpu.client import new_client
 from minbft_tpu.core import new_replica

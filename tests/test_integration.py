@@ -14,7 +14,6 @@ import asyncio
 
 import pytest
 
-from minbft_tpu import api
 from minbft_tpu.client import new_client
 from minbft_tpu.core import new_replica
 from minbft_tpu.sample.authentication import new_test_authenticators

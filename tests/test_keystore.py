@@ -11,6 +11,18 @@ import asyncio
 
 import pytest
 
+import importlib.util
+
+_HAVE_CRYPTOGRAPHY = importlib.util.find_spec("cryptography") is not None
+
+# Sealing and the wide NIST curves are OpenSSL-backed features of the
+# `cryptography` package: CI installs it; the bare jax_graft image runs
+# unsealed with the P-256/Ed25519 paths only.
+needs_cryptography = pytest.mark.skipif(
+    not _HAVE_CRYPTOGRAPHY,
+    reason="feature under test requires the optional cryptography package",
+)
+
 from minbft_tpu import api
 from minbft_tpu.sample.authentication import (
     KeyStore,
@@ -200,6 +212,7 @@ def test_mac_section_roundtrip_and_cluster(tmp_path):
     assert all(2 in k for k in stripped.mac_keys.replica_pair)
 
 
+@needs_cryptography
 def test_sealed_keystore_encrypts_all_private_material(tmp_path):
     """With an operator secret, keys.yaml holds no recoverable private
     material: signature private keys, sealed USIG blobs, and MAC keys are
@@ -248,6 +261,7 @@ def test_sealed_keystore_encrypts_all_private_material(tmp_path):
         KeyStore.load(path, secret=b"wrong")
 
 
+@needs_cryptography
 def test_seal_secret_from_env(tmp_path, monkeypatch):
     """save()/load() source the secret from MINBFT_SEAL_SECRET by default
     — the deployment flow needs no code changes to turn sealing on."""
@@ -297,6 +311,7 @@ def test_native_v3_encrypted_seal_roundtrip():
         native.NativeEcdsaUSIG.from_sealed(blob, secret=b"nope")
 
 
+@needs_cryptography
 def test_wide_curve_keyspecs_roundtrip():
     """Round-4 verdict missing #2 (reference keymanager.go:169-241 keyspec
     breadth): P-384/P-521 keystores generate, save/load, and authenticate
@@ -344,6 +359,7 @@ def test_wide_curve_keyspecs_roundtrip():
         asyncio.run(device_check())
 
 
+@needs_cryptography
 def test_engine_wired_wide_curve_routes_to_host():
     """An engine-wired P-384 authenticator must route signatures to the
     host path (device_capable=False), not raise on every verification."""

@@ -6,7 +6,6 @@ cross-checks the Python binding against the software USIG and the TPU
 batch-verification path: a natively-created UI must verify everywhere.
 """
 
-import hashlib
 import os
 import subprocess
 
@@ -63,8 +62,6 @@ def test_native_ui_verifies_via_python_software_path():
 def test_native_ui_verifies_on_tpu_batch_path():
     """usig_verify_items decomposes a native UI into the (pubkey, digest,
     sig) triple and the batch kernel accepts it (SIM backend)."""
-    import numpy as np
-
     from minbft_tpu.ops import lowering, p256
     from minbft_tpu.usig.software import usig_verify_items
 

@@ -7,11 +7,10 @@ import hashlib
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 from minbft_tpu.ops import p256
-from minbft_tpu.ops.limbs import from_limbs, to_limbs, to_mont
+from minbft_tpu.ops.limbs import from_limbs
 from minbft_tpu.utils import hostcrypto as hc
 
 

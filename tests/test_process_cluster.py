@@ -9,7 +9,6 @@ replica processes)."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time

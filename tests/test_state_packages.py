@@ -9,8 +9,6 @@ messagelog concurrent append/stream (messagelog_test.go:29-117).
 
 import asyncio
 
-import pytest
-
 from minbft_tpu.core.internal.clientstate import ClientState, ClientStates
 from minbft_tpu.core.internal.messagelog import MessageLog
 from minbft_tpu.core.internal.peerstate import PeerState, PeerStates

@@ -97,7 +97,6 @@ def test_generated_ui_counters_match_log_order():
         from minbft_tpu.core.internal.messagelog import MessageLog
         from minbft_tpu.core.usig_ui import make_ui_assigner
         from minbft_tpu.sample.authentication import new_test_authenticators
-        from minbft_tpu.usig import ui_from_bytes
 
         (auth,), _ = new_test_authenticators(1, usig_kind="hmac")
         assign = make_ui_assigner(auth)

@@ -10,12 +10,10 @@ log).  No real time elapses: timers are fired explicitly.
 
 import asyncio
 
-import pytest
-
 from minbft_tpu import api
 from minbft_tpu.core import new_replica
 from minbft_tpu.core.internal.timer import FakeTimerProvider
-from minbft_tpu.messages import ReqViewChange, Request, authen_bytes, marshal
+from minbft_tpu.messages import ReqViewChange, Request, authen_bytes
 from minbft_tpu.sample.authentication import new_test_authenticators
 from minbft_tpu.sample.config import SimpleConfiger
 from minbft_tpu.sample.conn.inprocess import (

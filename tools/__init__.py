@@ -1,0 +1,1 @@
+# Repo tooling namespace (`python -m tools.analyze` runs from the repo root).

@@ -1,0 +1,29 @@
+"""Project-aware static analysis suite (``python -m tools.analyze``).
+
+Four project passes — lock-discipline (LD), JAX-trace-purity (TP),
+message exhaustiveness (EX), secret-hygiene (SH) — plus a dead-code floor
+(DC) standing in for pyflakes on bare images.  See tools/analyze/README.md
+for how to run, suppress, extend, and regenerate the baseline.
+"""
+
+from .core import (  # noqa  (public API re-export)
+    AnalysisError,
+    Baseline,
+    Finding,
+    Pass,
+    Project,
+    all_passes,
+    register_pass,
+    run_passes,
+)
+
+__all__ = [
+    "AnalysisError",
+    "Baseline",
+    "Finding",
+    "Pass",
+    "Project",
+    "all_passes",
+    "register_pass",
+    "run_passes",
+]

@@ -1,0 +1,136 @@
+"""CLI for the static-analysis suite.
+
+    python -m tools.analyze                   # all passes, baseline applied
+    python -m tools.analyze --list-passes
+    python -m tools.analyze --select lock-discipline,secret-hygiene
+    python -m tools.analyze --write-baseline  # grandfather current findings
+    python -m tools.analyze --no-baseline     # full picture, nothing hidden
+
+Exit codes: 0 clean · 1 findings (or stale baseline entries) · 2 internal
+error / bad usage.  ``make lint`` runs this after compileall.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import AnalysisError, Baseline, Project, all_passes, run_passes
+
+
+def _default_root() -> Path:
+    # tools/analyze/__main__.py -> repo root is two levels up from tools/.
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="project-aware static analysis (see tools/analyze/README.md)",
+    )
+    ap.add_argument(
+        "--root",
+        type=Path,
+        default=_default_root(),
+        help="source root (default: the repository root)",
+    )
+    ap.add_argument(
+        "--select",
+        help="comma-separated pass names to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="baseline file (default: tools/analyze/baseline.json under root)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather all current findings into the baseline file",
+    )
+    ap.add_argument(
+        "--allow-stale",
+        action="store_true",
+        help="do not fail on baseline entries that no longer match "
+        "(transition aid; the default treats them as errors)",
+    )
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.list_passes:
+            for name, cls in sorted(all_passes().items()):
+                print(f"{cls.code_prefix:4} {name:18} {cls.description}")
+            return 0
+
+        project = Project(args.root)
+        select = args.select.split(",") if args.select else None
+        findings = run_passes(project, select=select)
+
+        baseline_path = args.baseline or (
+            project.root / "tools" / "analyze" / "baseline.json"
+        )
+
+        if args.write_baseline:
+            if select:
+                # A partial run sees only the selected passes' findings;
+                # writing it out would destroy every other pass's entries
+                # (and their justifications).
+                raise AnalysisError(
+                    "--write-baseline requires a full run; drop --select"
+                )
+            old = Baseline.load(baseline_path)
+            Baseline.from_findings(findings, old=old).save(baseline_path)
+            todo = sum(
+                1
+                for e in Baseline.load(baseline_path).entries.values()
+                if e.get("justification", "").startswith("TODO")
+            )
+            print(
+                f"baseline: wrote {len(findings)} finding(s) to "
+                f"{baseline_path}"
+                + (f" ({todo} entries need a justification)" if todo else "")
+            )
+            return 0
+
+        if args.no_baseline:
+            reported, stale = findings, []
+        else:
+            baseline = Baseline.load(baseline_path)
+            reported, suppressed, stale = baseline.apply(findings)
+            if suppressed and not args.quiet:
+                print(
+                    f"baseline: {len(suppressed)} grandfathered finding(s) "
+                    f"suppressed"
+                )
+
+        for f in reported:
+            print(f.render())
+        rc = 0
+        if reported:
+            print(f"{len(reported)} finding(s)")
+            rc = 1
+        if stale:
+            for fp in stale:
+                print(f"STALE baseline entry (fixed? remove it): {fp}")
+            if not args.allow_stale:
+                rc = 1
+        if rc == 0 and not args.quiet:
+            names = select or sorted(all_passes())
+            print(f"analyze: clean ({', '.join(names)})")
+        return rc
+    except AnalysisError as e:
+        print(f"analyze: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

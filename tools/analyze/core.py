@@ -1,0 +1,350 @@
+"""Core of the project static-analysis framework.
+
+The reference repo's `make check` floor is `go test -race` plus
+golangci-lint; this package is the Python-side analogue, specialized to
+THIS codebase's invariants (lock discipline, JAX trace purity, message
+exhaustiveness, secret hygiene) instead of generic style.  The pieces:
+
+- :class:`Project` — file discovery + parsed-AST cache over a source root.
+- :class:`Finding` — one diagnostic, with a line-number-free fingerprint so
+  baselines survive unrelated edits.
+- :class:`Pass` — analysis plug-in; register with :func:`register_pass`.
+- noqa suppressions — ``# noqa: LD001`` (or bare ``# noqa``) on the flagged
+  line, or a standalone ``# noqa: LD001`` comment on the line directly
+  above (for lines too dense to annotate inline).
+- baseline — a committed JSON file of grandfathered finding fingerprints
+  with per-entry justifications.  Baselined findings are suppressed;
+  baseline entries that no longer match anything are reported as STALE
+  (the finding was fixed — the entry must be removed) so the file can only
+  shrink by being burned down, never rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "AnalysisError",
+    "Baseline",
+    "Finding",
+    "Pass",
+    "Project",
+    "all_passes",
+    "register_pass",
+    "run_passes",
+]
+
+
+class AnalysisError(Exception):
+    """Internal analyzer failure (exit code 2 — never silently green)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic.
+
+    ``fingerprint`` deliberately excludes the line number: baselines must
+    survive unrelated edits shifting code up or down.  Two identical
+    findings in one file (same code + message) share a fingerprint; the
+    baseline stores a count so fixing one of them is still detected.
+    """
+
+    code: str  # e.g. "LD001"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+class Project:
+    """Source tree handle: file discovery plus a parsed-AST cache.
+
+    ``root`` is the repository root; every path the framework reports is
+    relative to it.  Passes receive the Project and pull whatever files
+    their config names — tests point ``root`` at a fixture tree to drive
+    the same passes over synthetic snippets.
+    """
+
+    def __init__(self, root: Path, config=None):
+        self.root = Path(root).resolve()
+        # Late import keeps core.py free of project specifics; tests pass
+        # their own config objects.
+        if config is None:
+            from . import project as project_defaults
+
+            config = project_defaults.default_config()
+        self.config = config
+        self._asts: Dict[str, ast.Module] = {}
+        self._sources: Dict[str, str] = {}
+
+    # -- file access --------------------------------------------------------
+
+    def rel(self, path: Path) -> str:
+        return path.resolve().relative_to(self.root).as_posix()
+
+    def exists(self, relpath: str) -> bool:
+        return (self.root / relpath).is_file()
+
+    def source(self, relpath: str) -> str:
+        src = self._sources.get(relpath)
+        if src is None:
+            try:
+                src = (self.root / relpath).read_text(encoding="utf-8")
+            except OSError as e:
+                raise AnalysisError(f"cannot read {relpath}: {e}") from e
+            self._sources[relpath] = src
+        return src
+
+    def tree(self, relpath: str) -> ast.Module:
+        tree = self._asts.get(relpath)
+        if tree is None:
+            try:
+                tree = ast.parse(self.source(relpath), filename=relpath)
+            except SyntaxError as e:
+                # compileall owns syntax errors; surface as analyzer error
+                # rather than crashing with a traceback.
+                raise AnalysisError(f"syntax error in {relpath}: {e}") from e
+            self._asts[relpath] = tree
+        return tree
+
+    def python_files(self, under: Optional[Sequence[str]] = None) -> List[str]:
+        """Repo-relative paths of tracked .py files under the given
+        directories (default: the config's source roots), sorted for
+        deterministic output, __pycache__ excluded."""
+        roots = under if under is not None else self.config.source_roots
+        out: List[str] = []
+        for r in roots:
+            p = self.root / r
+            if p.is_file():
+                out.append(r)
+                continue
+            if not p.is_dir():
+                continue
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts:
+                    continue
+                out.append(self.rel(f))
+        return sorted(set(out))
+
+
+# -- suppressions -----------------------------------------------------------
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*))?", re.I)
+
+
+def _noqa_codes(line: str) -> Optional[set]:
+    """The set of codes a line's noqa comment suppresses (empty set means
+    bare ``# noqa`` = all codes); None when the line has no noqa."""
+    m = _NOQA_RE.search(line)
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip().upper() for c in codes.split(",")}
+
+
+def is_suppressed(project: Project, finding: Finding) -> bool:
+    """True when the flagged line (or a standalone comment directly above
+    it) carries a matching ``# noqa`` suppression."""
+    try:
+        lines = project.source(finding.path).splitlines()
+    except AnalysisError:
+        # Findings can point at files that don't exist (EX200 "configured
+        # module missing") — nothing to suppress on.
+        return False
+    if not 1 <= finding.line <= len(lines):
+        return False
+    for text, standalone_only in (
+        (lines[finding.line - 1], False),
+        (lines[finding.line - 2] if finding.line >= 2 else "", True),
+    ):
+        if standalone_only and not text.strip().startswith("#"):
+            continue
+        codes = _noqa_codes(text)
+        if codes is not None and (not codes or finding.code.upper() in codes):
+            return True
+    return False
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+class Baseline:
+    """Committed grandfather list: fingerprint -> {count, justification}.
+
+    The contract: every entry MUST carry a human justification; entries
+    whose fingerprint no longer matches any live finding are *stale* and
+    reported as errors — the baseline only ever shrinks.
+    """
+
+    VERSION = 1
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None):
+        self.entries: Dict[str, dict] = entries or {}
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.is_file():
+            return cls()
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as e:
+            raise AnalysisError(f"unreadable baseline {path}: {e}") from e
+        if data.get("version") != cls.VERSION:
+            raise AnalysisError(
+                f"baseline {path} has version {data.get('version')!r}; "
+                f"expected {cls.VERSION} (regenerate with --write-baseline)"
+            )
+        return cls(dict(data.get("findings", {})))
+
+    def save(self, path: Path) -> None:
+        data = {
+            "version": self.VERSION,
+            "findings": {k: self.entries[k] for k in sorted(self.entries)},
+        }
+        path.write_text(json.dumps(data, indent=2) + "\n", encoding="utf-8")
+
+    @classmethod
+    def from_findings(
+        cls, findings: Iterable[Finding], old: Optional["Baseline"] = None
+    ) -> "Baseline":
+        """Regenerate from live findings, carrying over justifications of
+        entries that survive (new entries get a fill-me-in marker)."""
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+        entries = {}
+        for fp, n in counts.items():
+            prev = old.entries.get(fp) if old else None
+            entries[fp] = {
+                "count": n,
+                "justification": (
+                    prev.get("justification", "")
+                    if isinstance(prev, dict)
+                    else ""
+                )
+                or "TODO: justify or fix",
+            }
+        return cls(entries)
+
+    def apply(
+        self, findings: Sequence[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """-> (reported, suppressed, stale_fingerprints).
+
+        Each baseline entry absorbs up to ``count`` findings with its
+        fingerprint; findings beyond the budget are reported (a regression
+        added a new instance of a baselined pattern).  An entry with
+        LEFTOVER budget is stale too: some of its N instances were fixed,
+        and keeping the surplus would silently absorb the next regression
+        of the same pattern — the count must be burned down to match."""
+        budget = {fp: e.get("count", 0) for fp, e in self.entries.items()}
+        reported: List[Finding] = []
+        suppressed: List[Finding] = []
+        for f in findings:
+            if budget.get(f.fingerprint, 0) > 0:
+                budget[f.fingerprint] -= 1
+                suppressed.append(f)
+            else:
+                reported.append(f)
+        stale = sorted(fp for fp, left in budget.items() if left > 0)
+        return reported, suppressed, stale
+
+
+# -- pass registry ----------------------------------------------------------
+
+
+class Pass:
+    """One analysis plug-in.
+
+    Subclass, set ``code_prefix``/``name``/``description``, implement
+    :meth:`run`, and register the class with :func:`register_pass`.  A pass
+    emits raw findings; the framework applies noqa and the baseline.
+    """
+
+    code_prefix: str = "XX"
+    name: str = "unnamed"
+    description: str = ""
+
+    def run(self, project: Project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(cls: type) -> type:
+    if cls.name in _REGISTRY:
+        raise AnalysisError(f"duplicate pass name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_passes() -> Dict[str, type]:
+    # Importing the passes package populates the registry on first use.
+    from . import passes as _passes  # noqa: DC401 (import for side effect)
+
+    return dict(_REGISTRY)
+
+
+def run_passes(
+    project: Project,
+    select: Optional[Sequence[str]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> List[Finding]:
+    """Run the (selected) passes; returns noqa-filtered findings sorted by
+    location.  Baseline application is the caller's job (the CLI), so
+    library users see the full picture."""
+    passes = all_passes()
+    names = list(select) if select else sorted(passes)
+    findings: List[Finding] = []
+    for name in names:
+        if name not in passes:
+            raise AnalysisError(
+                f"unknown pass {name!r}; available: {', '.join(sorted(passes))}"
+            )
+        if progress:
+            progress(name)
+        findings.extend(passes[name]().run(project))
+    findings = [f for f in findings if not is_suppressed(project, f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+    return findings
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+
+def attr_path(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Normalize an attribute/subscript chain rooted at a Name into a
+    dotted path, subscripts skipped: ``self._queues[n].stats.padded`` ->
+    ("self", "_queues", "stats", "padded").  None for anything else."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return tuple(reversed(parts))
+        else:
+            return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ("" when not a plain name/attr chain)."""
+    path = attr_path(node.func)
+    return ".".join(path) if path else ""

@@ -1,0 +1,9 @@
+"""Analysis passes.  Importing this package registers every pass."""
+
+from . import (  # noqa  (imports ARE the registration side effect)
+    dead_code,
+    exhaustiveness,
+    lock_discipline,
+    secret_hygiene,
+    trace_purity,
+)

@@ -1,0 +1,221 @@
+"""DC: the pyflakes floor for images with no linter installed.
+
+``make lint`` prefers ruff/pyflakes when present, but the bare jax_graft
+image ships neither; this pass keeps the two highest-signal checks always
+available so the lint tier never silently degrades to compileall-only:
+
+DC401  unused import (module scope).  ``from x import y`` in an
+       ``__init__.py`` is treated as a re-export unless ``__all__`` exists
+       and omits the name; ``import x  # noqa`` works as everywhere else.
+DC402  unused local variable: a function-scope name assigned exactly by
+       plain ``name = …`` statements and never read.  Underscore-prefixed
+       names, tuple unpacking, augmented assignment, and functions using
+       ``locals()`` / ``exec`` are exempt (pyflakes F841's contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import Finding, Pass, Project, register_pass
+
+
+def _loaded_names(tree: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Load, ast.Del)
+        ):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # `x.y` loads x via the Name child; nothing extra needed —
+            # but `global x` and string annotations do need care:
+            continue
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            out.update(node.names)
+    return out
+
+
+def _string_annotation_names(tree: ast.AST) -> Set[str]:
+    """Names inside string annotations ("OrderedDict[int, Reply]") — a
+    deferred-evaluation load pyflakes also honors."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        ann = getattr(node, "annotation", None)
+        targets = [ann] if ann is not None else []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            targets += [a.annotation for a in node.args.args if a.annotation]
+            if node.returns:
+                targets.append(node.returns)
+        for t in targets:
+            if isinstance(t, ast.Constant) and isinstance(t.value, str):
+                try:
+                    sub = ast.parse(t.value, mode="eval")
+                except SyntaxError:
+                    continue
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    return out
+
+
+@register_pass
+class DeadCodePass(Pass):
+    code_prefix = "DC"
+    name = "dead-code"
+    description = "unused imports and unused local variables"
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config.dead
+        findings: List[Finding] = []
+        for relpath in project.python_files(cfg.roots):
+            findings.extend(self._check_module(project, cfg, relpath))
+        return findings
+
+    # -- module --------------------------------------------------------------
+
+    def _check_module(self, project, cfg, relpath: str) -> List[Finding]:
+        tree = project.tree(relpath)
+        src = project.source(relpath)
+        findings: List[Finding] = []
+        findings += self._unused_imports(cfg, relpath, tree, src)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings += self._unused_locals(relpath, node)
+        return findings
+
+    def _unused_imports(self, cfg, relpath, tree, src) -> List[Finding]:
+        is_init = relpath.endswith("__init__.py")
+        exported: Set[str] = set()
+        has_all = False
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        has_all = True
+                        if isinstance(node.value, (ast.List, ast.Tuple)):
+                            exported = {
+                                el.value
+                                for el in node.value.elts
+                                if isinstance(el, ast.Constant)
+                            }
+        if is_init and cfg.init_reexports_ok and not has_all:
+            return []
+
+        loaded = _loaded_names(tree) | _string_annotation_names(tree)
+        # names referenced in __all__ strings count as loads
+        loaded |= exported
+        # docstring-driven tools (doctest) are out of scope.
+
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    if local not in loaded:
+                        findings.append(
+                            Finding(
+                                "DC401",
+                                relpath,
+                                node.lineno,
+                                f"unused import {alias.name}",
+                            )
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    if local not in loaded:
+                        findings.append(
+                            Finding(
+                                "DC401",
+                                relpath,
+                                node.lineno,
+                                f"unused import {alias.name} from "
+                                f"{node.module or '.'}",
+                            )
+                        )
+        return findings
+
+    def _unused_locals(self, relpath, fn) -> List[Finding]:
+        # Bail out on dynamic scope usage.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                if node.func.id in ("locals", "exec", "eval", "vars"):
+                    return []
+
+        # Assignments in nested scopes belong to that scope's own analysis
+        # (walk is flat, so collect their subtree ids to skip).  ClassDef
+        # counts: `class Cfg: retries = 3` inside a function is a class
+        # attribute, not a local.
+        nested: Set[int] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+                )
+                and node is not fn
+            ):
+                for sub in ast.walk(node):
+                    nested.add(id(sub))
+
+        assigns: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            if id(node) in nested:
+                continue
+            if isinstance(node, ast.Assign):
+                if len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    name = node.targets[0].id
+                    if not name.startswith("_"):
+                        assigns.setdefault(name, []).append(node.lineno)
+
+        if not assigns:
+            return []
+        # Loads anywhere in the function INCLUDING nested defs (closures),
+        # plus global/nonlocal declarations, AugAssign reads, etc.
+        loaded: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and not isinstance(
+                node.ctx, ast.Store
+            ):
+                loaded.add(node.id)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                loaded.update(node.names)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                loaded.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # loop targets often intentionally unused
+                for el in ast.walk(node.target):
+                    if isinstance(el, ast.Name):
+                        loaded.add(el.id)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                for el in ast.walk(node.optional_vars):
+                    if isinstance(el, ast.Name):
+                        loaded.add(el.id)
+            elif isinstance(node, (ast.comprehension,)):
+                for el in ast.walk(node.target):
+                    if isinstance(el, ast.Name):
+                        loaded.add(el.id)
+        findings = []
+        for name, lines in sorted(assigns.items()):
+            if name in loaded:
+                continue
+            findings.append(
+                Finding(
+                    "DC402",
+                    relpath,
+                    lines[0],
+                    f"local variable {name} assigned but never used "
+                    f"in {fn.name}",
+                )
+            )
+        return findings

@@ -1,0 +1,292 @@
+"""EX: every declared message kind is wired through the whole stack.
+
+A new message kind in ``messages/message.py`` is only half a feature: it
+must marshal/unmarshal (codec), have canonical authen bytes when it
+carries a signature or UI (authen), and be dispatched by the replica
+(message_handling) — or be explicitly declared as handled elsewhere.
+Today that consistency lives in reviewers' heads; this pass makes it a
+lint failure:
+
+EX200  config/module problem (declared file or function missing)
+EX201  kind has no marshal branch in the codec
+EX202  kind is never constructed by the codec's unmarshal side
+EX203  kind carries ``signature``/``ui`` (or is classified signed /
+       certified) but has no authen-bytes rule and no configured
+       exemption
+EX204  kind is not dispatched in the configured handler functions and has
+       no (verified) alternative handler
+EX205  a configured exemption/alternative no longer holds (stale config)
+
+Kinds are discovered structurally: module-level classes with a ``KIND``
+class attribute, the abstract base (bare ``KIND = "?"``) excluded.
+Classification tuples (``CERTIFIED_MESSAGES = (Prepare, …)``) are parsed
+so an ``isinstance(msg, CERTIFIED_MESSAGES)`` dispatch covers its members.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, Pass, Project, register_pass
+
+
+def _isinstance_names(tree: ast.AST) -> Set[str]:
+    """Names used as the classinfo argument of isinstance() calls —
+    plain names, attribute tails, and tuple elements."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "isinstance"
+            and len(node.args) == 2
+        ):
+            continue
+        info = node.args[1]
+        elts = info.elts if isinstance(info, ast.Tuple) else [info]
+        for el in elts:
+            if isinstance(el, ast.Name):
+                out.add(el.id)
+            elif isinstance(el, ast.Attribute):
+                out.add(el.attr)
+    return out
+
+
+def _find_function(tree: ast.Module, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == name:
+                return node
+    return None
+
+
+@register_pass
+class ExhaustivenessPass(Pass):
+    code_prefix = "EX"
+    name = "exhaustiveness"
+    description = "message kinds wired through codec, authen and handlers"
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config.exhaustiveness
+        if cfg is None:
+            return []
+        findings: List[Finding] = []
+        for attr in ("message_module", "codec_module", "authen_module", "handler_module"):
+            relpath = getattr(cfg, attr)
+            if not project.exists(relpath):
+                findings.append(
+                    Finding("EX200", relpath, 1, f"configured {attr} missing")
+                )
+        if findings:
+            return findings
+
+        msg_tree = project.tree(cfg.message_module)
+        kinds, groups = self._declared_kinds(msg_tree)
+        if not kinds:
+            return [
+                Finding(
+                    "EX200",
+                    cfg.message_module,
+                    1,
+                    "no message kinds (classes with a KIND attribute) found",
+                )
+            ]
+
+        findings += self._check_codec(project, cfg, kinds)
+        findings += self._check_authen(project, cfg, kinds, groups)
+        findings += self._check_handlers(project, cfg, kinds, groups)
+        return findings
+
+    # -- declaration discovery ----------------------------------------------
+
+    @staticmethod
+    def _declared_kinds(tree: ast.Module):
+        """-> ({class name: {field names}}, {tuple name: {class names}})."""
+        kinds: Dict[str, Set[str]] = {}
+        for node in tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            fields: Set[str] = set()
+            kind_value = None
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            if t.id == "KIND" and isinstance(
+                                stmt.value, ast.Constant
+                            ):
+                                kind_value = stmt.value.value
+                            fields.add(t.id)
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    fields.add(stmt.target.id)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if stmt.name == "__init__":
+                        # dataclass(init=False) style: fields assigned in
+                        # __init__ count (Prepare does this).
+                        for sub in ast.walk(stmt):
+                            if isinstance(sub, ast.Attribute) and isinstance(
+                                sub.value, ast.Name
+                            ):
+                                if (
+                                    sub.value.id == "self"
+                                    and isinstance(sub.ctx, ast.Store)
+                                ):
+                                    fields.add(sub.attr)
+            if kind_value and kind_value != "?":
+                kinds[node.name] = fields
+        groups: Dict[str, Set[str]] = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Tuple
+            ):
+                names = {
+                    el.id
+                    for el in node.value.elts
+                    if isinstance(el, ast.Name) and el.id in kinds
+                }
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and names:
+                        groups[t.id] = names
+        return kinds, groups
+
+    # -- codec ---------------------------------------------------------------
+
+    def _check_codec(self, project, cfg, kinds) -> List[Finding]:
+        tree = project.tree(cfg.codec_module)
+        findings: List[Finding] = []
+        marshal = _find_function(tree, "marshal")
+        if marshal is None:
+            return [Finding("EX200", cfg.codec_module, 1, "no marshal() found")]
+        marshal_names = _isinstance_names(marshal)
+        constructed = {
+            node.func.id
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+        }
+        for kind in sorted(kinds):
+            if kind not in marshal_names:
+                findings.append(
+                    Finding(
+                        "EX201",
+                        cfg.codec_module,
+                        marshal.lineno,
+                        f"message kind {kind} has no marshal branch",
+                    )
+                )
+            if kind not in constructed:
+                findings.append(
+                    Finding(
+                        "EX202",
+                        cfg.codec_module,
+                        1,
+                        f"message kind {kind} is never constructed by the "
+                        f"unmarshal side",
+                    )
+                )
+        return findings
+
+    # -- authen ---------------------------------------------------------------
+
+    def _check_authen(self, project, cfg, kinds, groups) -> List[Finding]:
+        tree = project.tree(cfg.authen_module)
+        findings: List[Finding] = []
+        names = _isinstance_names(tree)
+        signed = groups.get("SIGNED_MESSAGES", set())
+        certified = groups.get("CERTIFIED_MESSAGES", set())
+        for kind, fields in sorted(kinds.items()):
+            needs = (
+                kind in signed
+                or kind in certified
+                or "signature" in fields
+                or "ui" in fields
+            )
+            exempt = cfg.authen_exempt.get(kind)
+            if needs and exempt is None and kind not in names:
+                findings.append(
+                    Finding(
+                        "EX203",
+                        cfg.authen_module,
+                        1,
+                        f"authenticated kind {kind} has no authen-bytes rule",
+                    )
+                )
+            if exempt is not None and (not needs or kind in names):
+                reason = (
+                    "kind now has an authen rule"
+                    if kind in names
+                    else "kind carries no signature/ui"
+                )
+                findings.append(
+                    Finding(
+                        "EX205",
+                        cfg.authen_module,
+                        1,
+                        f"stale authen exemption for {kind}: {reason} — "
+                        f"drop it from the analyzer config",
+                    )
+                )
+        return findings
+
+    # -- handlers --------------------------------------------------------------
+
+    def _check_handlers(self, project, cfg, kinds, groups) -> List[Finding]:
+        tree = project.tree(cfg.handler_module)
+        findings: List[Finding] = []
+        per_fn: Dict[str, Set[str]] = {}
+        for fname in cfg.handler_functions:
+            fn = _find_function(tree, fname)
+            if fn is None:
+                findings.append(
+                    Finding(
+                        "EX200",
+                        cfg.handler_module,
+                        1,
+                        f"configured handler function {fname}() not found",
+                    )
+                )
+                continue
+            names = _isinstance_names(fn)
+            # expand classification tuples into their member kinds
+            expanded = set(names)
+            for n in names:
+                expanded |= groups.get(n, set())
+            per_fn[fname] = expanded
+        for kind in sorted(kinds):
+            alt = cfg.handler_alternatives.get(kind)
+            if alt is not None:
+                alt_module, reason = alt
+                if not project.exists(alt_module):
+                    findings.append(
+                        Finding(
+                            "EX205",
+                            cfg.handler_module,
+                            1,
+                            f"alternative handler module for {kind} missing: "
+                            f"{alt_module}",
+                        )
+                    )
+                elif kind not in _isinstance_names(project.tree(alt_module)):
+                    findings.append(
+                        Finding(
+                            "EX205",
+                            cfg.handler_module,
+                            1,
+                            f"stale handler exemption for {kind}: {alt_module} "
+                            f"never isinstance-checks it ({reason})",
+                        )
+                    )
+                continue
+            for fname, handled in per_fn.items():
+                if kind not in handled:
+                    findings.append(
+                        Finding(
+                            "EX204",
+                            cfg.handler_module,
+                            1,
+                            f"message kind {kind} not dispatched in {fname}()",
+                        )
+                    )
+        return findings

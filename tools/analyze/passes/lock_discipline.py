@@ -1,0 +1,283 @@
+"""LD: lock discipline for the replica state classes and the engine.
+
+The reference repo leans on `go test -race` to catch unsynchronized
+access to the replica state objects; this pass is the static analogue for
+our mixed asyncio/thread build.  For each configured class
+(:class:`tools.analyze.project.LockClassSpec`):
+
+LD001  guarded attribute written outside the lock in a context that can
+       interleave (always, for ``mode="threads"``; for ``mode="loop"``
+       only inside async functions that contain a suspension point —
+       sync methods are event-loop-atomic).
+LD002  a lock attribute itself is rebound outside ``__init__`` (waiters
+       on the old lock and takers of the new one no longer exclude each
+       other).
+
+Writes = assignment / augmented assignment / ``del`` to a ``self.…``
+attribute path, plus in-place mutator calls (``self._done.add(x)``,
+``self._replies.popitem()``, …).  Attribute paths see through subscripts
+(``self._queues[n].stats.x`` -> ``_queues.stats.x``), and a guard spec
+matches a write to itself, any descendant, or any ancestor (replacing a
+container clobbers everything under it).
+
+Known limitation (documented in tools/analyze/README.md): aliasing
+(``st = self.stats; st.x += 1``) hides a write from the pass.  Keep
+guarded-state mutations on explicit ``self`` paths.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..core import Finding, Pass, Project, attr_path, register_pass
+
+# In-place mutators on builtin containers (a call through a guarded path
+# is as much a write as an assignment).
+_MUTATORS = {
+    "append",
+    "add",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "remove",
+    "setdefault",
+    "sort",
+    "reverse",
+    "update",
+    "move_to_end",
+    "appendleft",
+    "popleft",
+}
+
+_SELF = "self"
+
+
+def _self_path(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """("_attr", ...) for a self-rooted attribute chain, else None."""
+    path = attr_path(node)
+    if path and len(path) >= 2 and path[0] == _SELF:
+        return path[1:]
+    return None
+
+
+def _written_paths(stmt: ast.AST) -> List[Tuple[Tuple[str, ...], int]]:
+    """(path, lineno) of every self-attribute write in one statement."""
+    out: List[Tuple[Tuple[str, ...], int]] = []
+
+    def add(node: ast.AST) -> None:
+        p = _self_path(node)
+        if p:
+            out.append((p, node.lineno))
+
+    if isinstance(stmt, ast.Assign):
+        def add_target(t: ast.AST) -> None:
+            # Only the OUTERMOST node of each assignment target chain —
+            # walking into `self._m[k]` would double-count the inner
+            # Attribute as a second write.
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    add_target(el)
+            elif isinstance(t, ast.Starred):
+                add_target(t.value)
+            elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                add(t)
+
+        for t in stmt.targets:
+            add_target(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+            add(stmt.target)
+    elif isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            add(t)
+    elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        if isinstance(call.func, ast.Attribute) and call.func.attr in _MUTATORS:
+            p = _self_path(call.func.value)
+            if p:
+                out.append((p, call.lineno))
+    return out
+
+
+def _guard_matches(guard: Tuple[str, ...], path: Tuple[str, ...]) -> bool:
+    n = min(len(guard), len(path))
+    return guard[:n] == path[:n]
+
+
+def _has_suspension(fn: ast.AST, lock_regions: Set[int]) -> bool:
+    """Does the function body suspend (await / async for / async with)?
+
+    The lock-region ``async with`` HEADERS themselves don't count (a
+    method whose only suspension is acquiring its own lock cannot
+    interleave around its guarded writes) — but suspensions INSIDE a lock
+    region do: ``await self._cond.wait()`` both suspends and releases the
+    lock, so any unlocked write elsewhere in the function races it."""
+    ignore: Set[int] = set()
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.AsyncFunctionDef, ast.FunctionDef, ast.Lambda)):
+            # nested defs run later, not at this function's await points
+            for sub in ast.walk(node):
+                ignore.add(id(sub))
+            continue
+        if id(node) in ignore:
+            continue
+        if isinstance(node, ast.AsyncWith) and id(node) in lock_regions:
+            continue  # the acquire itself; children still walked
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+    return False
+
+
+@register_pass
+class LockDisciplinePass(Pass):
+    code_prefix = "LD"
+    name = "lock-discipline"
+    description = (
+        "guarded state-class attributes written only under their lock"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for spec in project.config.lock_classes:
+            if not project.exists(spec.path):
+                findings.append(
+                    Finding(
+                        "LD000",
+                        spec.path,
+                        1,
+                        f"configured class {spec.cls} not found: file missing",
+                    )
+                )
+                continue
+            cls = self._find_class(project.tree(spec.path), spec.cls)
+            if cls is None:
+                findings.append(
+                    Finding(
+                        "LD000",
+                        spec.path,
+                        1,
+                        f"configured class {spec.cls} not found in module",
+                    )
+                )
+                continue
+            findings.extend(self._check_class(project, spec, cls))
+        return findings
+
+    # -- per-class ----------------------------------------------------------
+
+    @staticmethod
+    def _find_class(tree: ast.Module, name: str) -> Optional[ast.ClassDef]:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return node
+        return None
+
+    def _check_class(self, project, spec, cls: ast.ClassDef) -> List[Finding]:
+        guards = self._guard_set(spec, cls)
+        findings: List[Finding] = []
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                continue
+            findings.extend(self._check_function(project, spec, guards, fn))
+        return findings
+
+    def _guard_set(self, spec, cls: ast.ClassDef) -> List[Tuple[str, ...]]:
+        guards = [
+            tuple(g.split(".")) for g in spec.guarded if g != "auto"
+        ]
+        if "auto" in spec.guarded:
+            # Lock-affinity inference: any attribute path the class writes
+            # under one of its locks anywhere is a guarded attribute.
+            inferred: Set[Tuple[str, ...]] = set()
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for region in self._lock_regions(fn, spec.locks):
+                    for stmt in ast.walk(region):
+                        for path, _ in _written_paths(stmt):
+                            if path[0] not in spec.locks:
+                                inferred.add(path)
+            guards.extend(sorted(inferred))
+        return guards
+
+    @staticmethod
+    def _lock_regions(fn: ast.AST, locks) -> List[ast.AST]:
+        regions = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    p = _self_path(item.context_expr)
+                    # `with self._lock:` or `async with self._cond:` (a
+                    # `.acquire()`-style call chain also resolves — the
+                    # path helper skips the Call by not matching; accept
+                    # plain attr paths only).
+                    if p and p[0] in locks:
+                        regions.append(node)
+                        break
+        return regions
+
+    def _check_function(self, project, spec, guards, fn) -> List[Finding]:
+        findings: List[Finding] = []
+        lock_nodes: Set[int] = set()
+        for region in self._lock_regions(fn, spec.locks):
+            for sub in ast.walk(region):
+                lock_nodes.add(id(sub))
+        region_ids = {
+            id(region) for region in self._lock_regions(fn, spec.locks)
+        }
+        is_async = isinstance(fn, ast.AsyncFunctionDef)
+        if spec.mode == "threads":
+            interleaves = True
+        else:
+            interleaves = is_async and _has_suspension(fn, region_ids)
+
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and stmt is not fn:
+                continue  # handled via walk anyway; writes in nested defs still count
+            for path, line in _written_paths(stmt):
+                # LD002: rebinding the lock itself.
+                if path[0] in spec.locks and len(path) == 1:
+                    findings.append(
+                        Finding(
+                            "LD002",
+                            spec.path,
+                            line,
+                            f"{spec.cls}.{path[0]} (a lock) rebound outside "
+                            f"__init__ in {fn.name}",
+                        )
+                    )
+                    continue
+                if not any(_guard_matches(g, path) for g in guards):
+                    continue
+                if id(stmt) in lock_nodes:
+                    continue  # write is under the lock
+                if not interleaves:
+                    continue  # loop-atomic context
+                ctx = (
+                    "thread-shared"
+                    if spec.mode == "threads"
+                    else "suspending async method"
+                )
+                how = (
+                    f"outside with {', '.join(spec.locks)}"
+                    if spec.locks
+                    else "in a class with no lock"
+                )
+                findings.append(
+                    Finding(
+                        "LD001",
+                        spec.path,
+                        line,
+                        f"{spec.cls}.{'.'.join(path)} written in {fn.name} "
+                        f"({ctx}) {how}",
+                    )
+                )
+        return findings

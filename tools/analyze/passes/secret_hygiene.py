@@ -1,0 +1,185 @@
+"""SH: key material must never reach a string-formatting or logging sink.
+
+Key material originates in ``sample/authentication/keystore.py``,
+``utils/hostcrypto.py`` and ``utils/sealbox.py`` and flows through the
+authenticators and USIGs under consistently secret-shaped names (``priv``,
+``seed``, ``sealed``, ``_key``, ``secret``, ``scalar`` …).  The pass
+name-taints identifiers by their underscore-separated words
+(:class:`tools.analyze.project.SecretHygieneConfig`) and flags every
+formatting/printing sink a tainted expression reaches:
+
+SH301  tainted value interpolated into an f-string (incl. ``{x!r}``)
+SH302  tainted value passed to print() / a logging call
+       (``log.*``, ``logger.*``, ``logging.*``, ``.debug``…``.critical``)
+SH303  ``repr()`` / ``str()`` / ``bytes.hex()`` applied to a tainted value
+       in argument position of a formatting sink, or ``%``/``.format``
+       interpolation of a tainted value
+
+Names whose words also match the public pattern (``pub``, ``keyspec``,
+``key_id``, ``fingerprint`` …) are NOT tainted — logging a key *id* or a
+key *spec* is fine; logging the key is not.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from ..core import Finding, Pass, Project, attr_path, call_name, register_pass
+
+_LOG_METHODS = {
+    "debug",
+    "info",
+    "warning",
+    "warn",
+    "error",
+    "exception",
+    "critical",
+    "log",
+}
+_LOG_BASES = {"log", "logger", "logging"}
+
+
+def _words(name: str) -> List[str]:
+    # split snake_case and lowered camelCase into words
+    name = re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", name)
+    return [w for w in name.lower().split("_") if w]
+
+
+class _Taint:
+    def __init__(self, cfg):
+        self._secret = re.compile(cfg.secret_re)
+        self._public = re.compile(cfg.public_re)
+
+    def name_is_secret(self, name: str) -> bool:
+        ws = _words(name)
+        if not ws:
+            return False
+        if any(self._public.match(w) for w in ws):
+            return False
+        return any(self._secret.match(w) for w in ws)
+
+    def expr_secrets(self, expr: ast.AST) -> Set[str]:
+        """Secret-tainted identifiers whose *value* the expression can
+        expose.  Comparisons, ``is None`` checks and conditional tests
+        yield booleans — mentioning a secret there reveals nothing, so
+        those subtrees are skipped; ``len(secret)`` likewise."""
+        out: Set[str] = set()
+        skip: Set[int] = set()
+        for node in ast.walk(expr):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+                continue
+            if isinstance(node, ast.IfExp):
+                for sub in ast.walk(node.test):
+                    skip.add(id(sub))
+                continue
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in ("len", "bool", "type", "id"):
+                    for sub in ast.walk(node):
+                        skip.add(id(sub))
+                    continue
+            if isinstance(node, ast.Name) and self.name_is_secret(node.id):
+                out.add(node.id)
+            elif isinstance(node, ast.Attribute) and self.name_is_secret(
+                node.attr
+            ):
+                path = attr_path(node)
+                out.add(".".join(path) if path else node.attr)
+        return out
+
+
+def _is_log_call(cn: str) -> bool:
+    parts = cn.split(".")
+    if parts[-1] in _LOG_METHODS and (
+        len(parts) == 1 or parts[0] in _LOG_BASES or parts[-2] in _LOG_BASES
+    ):
+        return True
+    return cn in ("print",)
+
+
+@register_pass
+class SecretHygienePass(Pass):
+    code_prefix = "SH"
+    name = "secret-hygiene"
+    description = "no key material in f-strings, logs, print or repr"
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config.secrets
+        taint = _Taint(cfg)
+        findings: List[Finding] = []
+        for relpath in project.python_files(cfg.roots):
+            findings.extend(self._check_module(project, taint, relpath))
+        return findings
+
+    def _check_module(self, project, taint: _Taint, relpath: str) -> List[Finding]:
+        tree = project.tree(relpath)
+        findings: List[Finding] = []
+
+        def emit(code: str, line: int, what: str, names: Set[str]) -> None:
+            findings.append(
+                Finding(
+                    code,
+                    relpath,
+                    line,
+                    f"{what} interpolates secret-named value(s) "
+                    + ", ".join(sorted(names)),
+                )
+            )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.JoinedStr):
+                names: Set[str] = set()
+                for part in node.values:
+                    if isinstance(part, ast.FormattedValue):
+                        names |= taint.expr_secrets(part.value)
+                if names:
+                    emit("SH301", node.lineno, "f-string", names)
+            elif isinstance(node, ast.Call):
+                cn = call_name(node)
+                if _is_log_call(cn):
+                    names = set()
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if isinstance(arg, ast.JoinedStr):
+                            continue  # SH301 already covers f-string args
+                        names |= taint.expr_secrets(arg)
+                    if names:
+                        emit("SH302", node.lineno, f"{cn}() call", names)
+                elif cn in ("repr", "str", "ascii"):
+                    names = set()
+                    for arg in node.args:
+                        names |= taint.expr_secrets(arg)
+                    if names:
+                        emit("SH303", node.lineno, f"{cn}()", names)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "format"
+                ):
+                    # cn is "" for a literal base ("{}".format(secret)) —
+                    # match on the attribute name instead.
+                    names = set()
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        names |= taint.expr_secrets(arg)
+                    if names:
+                        emit("SH303", node.lineno, ".format() call", names)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "hex"
+                ):
+                    names = taint.expr_secrets(node.func.value)
+                    if names:
+                        emit("SH303", node.lineno, ".hex()", names)
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                # "..%s.." % secret — only when the left side is a string
+                if isinstance(node.left, ast.Constant) and isinstance(
+                    node.left.value, str
+                ):
+                    names = taint.expr_secrets(node.right)
+                    if names:
+                        emit("SH303", node.lineno, "%-format", names)
+        return findings

@@ -1,0 +1,334 @@
+"""TP: purity of functions reachable from jitted entry points.
+
+A function traced by ``jax.jit`` (directly, via ``per_mode_jit``, or as a
+``vmap``/``scan`` body) runs ONCE at trace time; Python side effects in it
+silently bake into the compiled program or, worse, force host syncs on
+every dispatch.  The pass:
+
+1. finds trace roots in the configured modules — functions passed to any
+   configured jit wrapper (``per_mode_jit(jax.vmap(_verify_one))`` marks
+   ``_verify_one``), decorated with one, or defined and returned inside a
+   factory that wraps them;
+2. builds a same-package call graph (local names + ``from . import x``
+   between configured modules) and takes the reachable set;
+3. flags, inside reachable bodies:
+
+TP101  host I/O or impure builtins: print / open / input
+TP102  numpy host ops on traced values: ``np.*`` calls (host transfer),
+       ``.block_until_ready()``, ``jax.device_get``, ``.item()``
+TP103  host entropy/time/environment: time.* / random.* / secrets.* /
+       os.* / logging.*
+TP104  ``global`` statement (trace-time mutation of module state)
+TP105  data-dependent Python branching: ``if`` / ``while`` / ``assert``
+       whose test is tainted by a function parameter (a traced value has
+       no Python truth value; only ``.shape`` / ``.dtype`` / ``.ndim`` /
+       ``len()`` are static under trace)
+
+TP102/TP105 use a one-pass forward taint within the function: parameters
+are tainted; locals assigned from tainted expressions become tainted;
+shape / dtype / ndim / len projections launder the taint.  Parameters
+annotated with a static Python type (``int``, ``float``, ``bool``,
+``str``, ``bytes``) are NOT tainted — they are trace-time constants, and
+``np.*`` on host-static values is a legitimate trace-time constant
+construction, not a device sync.  Other static-config parameters can be
+declared in ``TracePurityConfig.static_params`` or suppressed with
+``# noqa: TP105``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, Pass, Project, attr_path, call_name, register_pass
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes"}
+_HOST_PREFIXES = ("time.", "random.", "secrets.", "os.", "logging.")
+_NP_NAMES = ("np.", "numpy.", "onp.")
+
+
+def _fn_key(relpath: str, name: str) -> Tuple[str, str]:
+    return (relpath, name)
+
+
+class _ModuleIndex:
+    """Per-module function table + import map."""
+
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.tree = tree
+        self.functions: Dict[str, ast.AST] = {}
+        self.imports: Dict[str, Tuple[str, str]] = {}  # local -> (modname, orig)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Last definition wins (same as runtime rebinding).
+                self.functions[node.name] = node
+        for node in tree.body:
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        node.module.rsplit(".", 1)[-1],
+                        alias.name,
+                    )
+
+
+@register_pass
+class TracePurityPass(Pass):
+    code_prefix = "TP"
+    name = "trace-purity"
+    description = "no Python side effects reachable from jitted entry points"
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config.trace
+        modules: Dict[str, _ModuleIndex] = {}
+        by_stem: Dict[str, _ModuleIndex] = {}
+        for relpath in project.python_files(cfg.roots):
+            idx = _ModuleIndex(relpath, project.tree(relpath))
+            modules[relpath] = idx
+            stem = relpath.rsplit("/", 1)[-1][: -len(".py")]
+            by_stem[stem] = idx
+
+        wrappers = set(cfg.jit_wrappers)
+        roots: Set[Tuple[str, str]] = set()
+        for idx in modules.values():
+            roots |= self._find_roots(idx, wrappers)
+
+        reachable = self._reachable(roots, modules, by_stem)
+
+        findings: List[Finding] = []
+        for relpath, name in sorted(reachable):
+            idx = modules.get(relpath)
+            fn = idx.functions.get(name) if idx else None
+            if fn is not None:
+                findings.extend(self._check_body(project, idx, fn))
+        return findings
+
+    # -- root discovery ------------------------------------------------------
+
+    def _find_roots(self, idx: _ModuleIndex, wrappers) -> Set[Tuple[str, str]]:
+        roots: Set[Tuple[str, str]] = set()
+
+        def mark(node: ast.AST) -> None:
+            if isinstance(node, ast.Name) and node.id in idx.functions:
+                roots.add(_fn_key(idx.relpath, node.id))
+            elif isinstance(node, ast.Lambda):
+                # anonymous body: check it inline as a pseudo-function
+                name = f"<lambda@{node.lineno}>"
+                idx.functions[name] = node
+                roots.add(_fn_key(idx.relpath, name))
+            elif isinstance(node, ast.Call):
+                # nested wrapping: per_mode_jit(jax.vmap(f)) / partial(f, …)
+                cn = call_name(node)
+                if cn in wrappers or cn.endswith("partial"):
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        mark(arg)
+
+        for node in ast.walk(idx.tree):
+            if isinstance(node, ast.Call) and call_name(node) in wrappers:
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    mark(arg)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dn = (
+                        call_name(dec)
+                        if isinstance(dec, ast.Call)
+                        else ".".join(attr_path(dec) or ())
+                    )
+                    if dn in wrappers:
+                        roots.add(_fn_key(idx.relpath, node.name))
+        return roots
+
+    # -- call graph ----------------------------------------------------------
+
+    def _reachable(self, roots, modules, by_stem) -> Set[Tuple[str, str]]:
+        seen: Set[Tuple[str, str]] = set()
+        work = list(roots)
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            relpath, name = key
+            idx = modules.get(relpath)
+            fn = idx.functions.get(name) if idx else None
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                path = attr_path(node.func)
+                if not path:
+                    continue
+                if len(path) == 1:
+                    callee = path[0]
+                    if callee in idx.functions:
+                        work.append(_fn_key(relpath, callee))
+                    elif callee in idx.imports:
+                        mod, orig = idx.imports[callee]
+                        target = by_stem.get(mod)
+                        if target and orig in target.functions:
+                            work.append(_fn_key(target.relpath, orig))
+                elif len(path) == 2 and path[0] in by_stem:
+                    # module-qualified call between configured modules
+                    target = by_stem[path[0]]
+                    if path[1] in target.functions:
+                        work.append(_fn_key(target.relpath, path[1]))
+        return seen
+
+    # -- body checks ---------------------------------------------------------
+
+    def _check_body(self, project, idx: _ModuleIndex, fn: ast.AST) -> List[Finding]:
+        cfg = project.config.trace
+        relpath = idx.relpath
+        fname = getattr(fn, "name", "<lambda>")
+        findings: List[Finding] = []
+
+        def emit(code: str, line: int, msg: str) -> None:
+            findings.append(
+                Finding(code, relpath, line, f"{msg} in traced function {fname}")
+            )
+
+        nested: Set[int] = set()
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not fn
+            ):
+                # Nested defs are separate graph nodes (reached via calls);
+                # don't double-report their bodies here.
+                for sub in ast.walk(node):
+                    nested.add(id(sub))
+
+        tainted = self._taint(fn, cfg, relpath, fname)
+
+        for node in ast.walk(fn):
+            if id(node) in nested or node is fn:
+                continue
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in ("print", "open", "input"):
+                    emit("TP101", node.lineno, f"call to {cn}()")
+                elif cn.startswith(_NP_NAMES):
+                    # np on host-static values builds trace-time constants
+                    # (fine); np on a traced value forces a host transfer.
+                    args = list(node.args) + [k.value for k in node.keywords]
+                    touched = set()
+                    for a in args:
+                        touched |= self._tainted_names(a, tainted)
+                    if touched:
+                        emit(
+                            "TP102",
+                            node.lineno,
+                            f"numpy host call {cn}() on traced value(s) "
+                            f"{', '.join(sorted(touched))} (forces "
+                            f"device->host sync)",
+                        )
+                elif cn in ("jax.device_get", "device_get"):
+                    emit("TP102", node.lineno, f"host sync {cn}()")
+                elif cn.endswith(".block_until_ready") or cn.endswith(".item"):
+                    emit("TP102", node.lineno, f"host sync .{cn.rsplit('.', 1)[-1]}()")
+                elif cn.startswith(_HOST_PREFIXES):
+                    emit(
+                        "TP103",
+                        node.lineno,
+                        f"host-side call {cn}() (entropy/time/env/log)",
+                    )
+            elif isinstance(node, ast.Global):
+                emit("TP104", node.lineno, "global statement")
+            elif isinstance(node, (ast.If, ast.While)):
+                names = self._tainted_names(node.test, tainted)
+                if names:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    emit(
+                        "TP105",
+                        node.lineno,
+                        f"data-dependent Python `{kind}` on traced "
+                        f"value(s) {', '.join(sorted(names))}",
+                    )
+            elif isinstance(node, ast.Assert):
+                names = self._tainted_names(node.test, tainted)
+                if names:
+                    emit(
+                        "TP105",
+                        node.lineno,
+                        "assert on traced value(s) "
+                        + ", ".join(sorted(names)),
+                    )
+        return findings
+
+    # -- taint ----------------------------------------------------------------
+
+    @staticmethod
+    def _param_static(a: ast.arg, static_types) -> bool:
+        ann = a.annotation
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip()
+        elif isinstance(ann, ast.Name):
+            name = ann.id
+        else:
+            return False
+        return name in static_types
+
+    @classmethod
+    def _taint(cls, fn: ast.AST, cfg, relpath: str, fname: str) -> Set[str]:
+        static = set(cfg.static_params.get((relpath, fname), ()))
+        args = getattr(fn, "args", None)
+        tainted: Set[str] = set()
+        if args is not None:
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                if (
+                    a.arg not in static
+                    and a.arg != "self"
+                    and not cls._param_static(a, set(cfg.static_types))
+                ):
+                    tainted.add(a.arg)
+        # One forward sweep in source order: locals assigned from tainted
+        # expressions inherit the taint (loops would need a fixpoint; one
+        # sweep covers the straight-line kernel style this repo uses).
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and TracePurityPass._expr_tainted(
+                node.value, tainted
+            ):
+                for t in node.targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name):
+                            tainted.add(el.id)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                if TracePurityPass._expr_tainted(node.value, tainted):
+                    tainted.add(node.target.id)
+        return tainted
+
+    @staticmethod
+    def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+        return bool(TracePurityPass._tainted_names(expr, tainted))
+
+    @staticmethod
+    def _tainted_names(expr: ast.AST, tainted: Set[str]) -> Set[str]:
+        """Tainted parameter/local names the expression depends on, with
+        static projections (.shape/.dtype/.ndim/len()) laundered."""
+        found: Set[str] = set()
+        skip: Set[int] = set()
+        for node in ast.walk(expr):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+                continue
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in ("len", "isinstance", "type"):
+                    for sub in ast.walk(node):
+                        skip.add(id(sub))
+                    continue
+            if isinstance(node, ast.Name) and node.id in tainted:
+                found.add(node.id)
+        return found

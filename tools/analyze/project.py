@@ -1,0 +1,276 @@
+"""Project wiring for the analysis passes.
+
+Everything repo-specific lives HERE (and in the committed baseline), not
+in the passes: the passes implement reusable checks, this module tells
+them which files, classes, locks, and message kinds this codebase cares
+about.  Tests build their own config objects pointed at fixture trees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# lock discipline
+
+
+@dataclasses.dataclass(frozen=True)
+class LockClassSpec:
+    """One state class under lock discipline.
+
+    ``mode``:
+
+    - ``"threads"`` — real preemptive concurrency (worker threads touch the
+      attributes): EVERY write to a guarded attribute outside ``__init__``
+      must be inside ``with <lock>``.
+    - ``"loop"`` — asyncio event-loop confined: writes in sync methods (or
+      async methods with no suspension point) are loop-atomic and allowed;
+      writes in an async method that CAN suspend must hold the lock — a
+      mutation racing an ``await`` is exactly the interleaving hazard the
+      reference's race-detector tier exists to catch.
+
+    ``guarded`` entries are dotted attribute paths relative to ``self``
+    (subscripts are wildcards): ``"_next_cv"``, ``"_queues.stats"``.  The
+    special value ``"auto"`` infers the guarded set: every attribute path
+    the class itself writes under one of its locks somewhere (lock-affinity
+    inference — if the code bothers to lock an attribute once, unlocked
+    writes elsewhere are suspect).
+    """
+
+    path: str
+    cls: str
+    locks: Tuple[str, ...]
+    guarded: Tuple[str, ...] = ("auto",)
+    mode: str = "loop"
+
+
+# ---------------------------------------------------------------------------
+# trace purity
+
+
+@dataclasses.dataclass(frozen=True)
+class TracePurityConfig:
+    """Where jitted code lives and what marks a function as a trace root."""
+
+    roots: Tuple[str, ...] = ()
+    # Call wrappers whose function-valued arguments become traced code.
+    jit_wrappers: Tuple[str, ...] = (
+        "jax.jit",
+        "jit",
+        "per_mode_jit",
+        "jax.vmap",
+        "vmap",
+        "jax.pmap",
+        "shard_map",
+        "jax.lax.scan",
+        "lax.scan",
+        "jax.lax.fori_loop",
+        "lax.fori_loop",
+        "jax.lax.while_loop",
+        "lax.while_loop",
+        "jax.lax.cond",
+        "lax.cond",
+        "jax.checkpoint",
+        "jax.remat",
+    )
+    # Annotation names that mark a parameter as a host-static Python value
+    # (never a tracer): branching on it and np.* over it are trace-time
+    # constant folding, not impurity.
+    static_types: Tuple[str, ...] = ("int", "float", "bool", "str", "bytes")
+    # (module-relative path, function name) -> parameter names that are
+    # static Python values at trace time (branching on them is fine).
+    static_params: Dict[Tuple[str, str], Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+# ---------------------------------------------------------------------------
+# handler / codec exhaustiveness
+
+
+@dataclasses.dataclass(frozen=True)
+class ExhaustivenessConfig:
+    message_module: str = "minbft_tpu/messages/message.py"
+    codec_module: str = "minbft_tpu/messages/codec.py"
+    authen_module: str = "minbft_tpu/messages/authen.py"
+    handler_module: str = "minbft_tpu/core/message_handling.py"
+    # Dispatch functions every wire-processable kind must appear in
+    # (directly or via a classification tuple like CERTIFIED_MESSAGES).
+    handler_functions: Tuple[str, ...] = ("validate_message", "process_message")
+    # kind -> (module that MUST handle it instead, reason).  The pass
+    # verifies the alternative module really isinstance-checks the kind —
+    # an exemption that stops being true becomes a finding again.
+    handler_alternatives: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # kind -> reason it legitimately has no authen-bytes rule.
+    authen_exempt: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# secret hygiene
+
+
+@dataclasses.dataclass(frozen=True)
+class SecretHygieneConfig:
+    """Name-taint rules for key material.
+
+    An identifier is secret-tainted when ``secret_re`` matches one of its
+    underscore-separated words and ``public_re`` does not.  The word split
+    keeps "keyspec"/"monkey" out while catching "key", "priv", "seed".
+    """
+
+    roots: Tuple[str, ...] = ()
+    secret_re: str = (
+        r"^(priv|private|privkey|secret|secrets|sealed|seed|scalar|sk|mk|"
+        r"master|key|keys|mackey|passphrase|password)$"
+    )
+    public_re: str = (
+        r"^(pub|public|keyspec|keystore|keytool|id|ids|kid|anchor|anchors|"
+        r"fingerprint|digest|spec|store|error|file|path|len|size|env)$"
+    )
+
+
+# ---------------------------------------------------------------------------
+# dead code (the pyflakes floor for bare images)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeadCodeConfig:
+    roots: Tuple[str, ...] = ()
+    # ``from x import y`` in an __init__.py is the re-export idiom; only
+    # flag unused imports there when the module defines __all__ and the
+    # name is not listed.
+    init_reexports_ok: bool = True
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalyzeConfig:
+    source_roots: Tuple[str, ...]
+    lock_classes: Tuple[LockClassSpec, ...]
+    trace: TracePurityConfig
+    exhaustiveness: Optional[ExhaustivenessConfig]
+    secrets: SecretHygieneConfig
+    dead: DeadCodeConfig
+
+
+def default_config() -> AnalyzeConfig:
+    """The wiring for THIS repository."""
+    return AnalyzeConfig(
+        source_roots=(
+            "minbft_tpu",
+            "tests",
+            "tools/analyze",
+            "bench.py",
+            "__graft_entry__.py",
+        ),
+        lock_classes=(
+            # Replica-internal state machines (ISSUE: the reference's
+            # `go test -race` tier).  All are event-loop confined; their
+            # condvars/locks protect state mutated across awaits.
+            LockClassSpec(
+                path="minbft_tpu/core/internal/clientstate.py",
+                cls="ClientState",
+                locks=("_cond",),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/core/internal/peerstate.py",
+                cls="PeerState",
+                locks=("_cond",),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/core/internal/viewstate.py",
+                cls="ViewState",
+                locks=("_write_lock",),
+                guarded=("_current",),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/core/internal/messagelog.py",
+                cls="MessageLog",
+                locks=(),
+                guarded=("_entries", "_seq0", "_waiters"),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/core/internal/requestlist.py",
+                cls="RequestList",
+                locks=(),
+                guarded=("_by_client",),
+            ),
+            # The batching engine is the one place real threads touch
+            # shared state (dispatchers run via asyncio.to_thread):
+            # kernel memo and cross-thread stats need their locks held on
+            # every write.
+            LockClassSpec(
+                path="minbft_tpu/parallel/engine.py",
+                cls="BatchVerifier",
+                locks=("_sharded_lock", "_stats_lock"),
+                # EXPLICIT, not "auto": inference learns guards from
+                # locked writes, so deleting every `with self._stats_lock`
+                # at once would silently un-guard the attribute.  These
+                # two pin the kernel memo and the cross-thread
+                # padded_lanes accounting (the round-1 race fix)
+                # regardless of what the code currently locks.
+                guarded=("_sharded_kernels", "_queues.stats.padded_lanes"),
+                mode="threads",
+            ),
+            LockClassSpec(
+                path="minbft_tpu/parallel/engine.py",
+                cls="_SchemeQueue",
+                locks=(),
+                guarded=("pending", "_memo", "_neg_memo", "_inflight_futs"),
+            ),
+            # The software USIG's counter is certified-then-incremented
+            # under a real threading.Lock (reference ecallLock).
+            LockClassSpec(
+                path="minbft_tpu/usig/software.py",
+                cls="_BaseUSIG",
+                locks=("_lock",),
+                guarded=("_counter",),
+                mode="threads",
+            ),
+        ),
+        trace=TracePurityConfig(
+            roots=("minbft_tpu/ops", "minbft_tpu/parallel"),
+            # FieldSpec bundles host-static field constants (moduli,
+            # Montgomery R^2, …) — see ops/limbs.py.
+            static_types=("int", "float", "bool", "str", "bytes", "FieldSpec"),
+        ),
+        exhaustiveness=ExhaustivenessConfig(
+            handler_alternatives={
+                # HELLO is the transport handshake: consumed by the
+                # connection-level hello handler in message_handling.py
+                # before the replica dispatch ever sees it.
+                "Hello": (
+                    "minbft_tpu/core/message_handling.py",
+                    "transport handshake (make_hello_handler)",
+                ),
+                # REPLY is client-bound: replicas emit it, only the client
+                # validates/consumes it.
+                "Reply": (
+                    "minbft_tpu/client/client.py",
+                    "client-side message (Client._handle_reply path)",
+                ),
+            },
+            # No authen exemptions needed: LogBase — the one unsigned kind —
+            # carries neither a signature nor a ui field, so the structural
+            # rule already exempts it (its claim is the embedded
+            # f+1-checkpoint certificate; see messages.message.LogBase).
+            authen_exempt={},
+        ),
+        secrets=SecretHygieneConfig(
+            roots=("minbft_tpu",),
+        ),
+        dead=DeadCodeConfig(
+            roots=(
+                "minbft_tpu",
+                "tests",
+                "tools/analyze",
+                "bench.py",
+                "__graft_entry__.py",
+            ),
+        ),
+    )
