@@ -8,6 +8,21 @@ jitted batch kernel.  Extras report the HMAC kernel rate and an end-to-end
 committed-requests/sec figure from an in-process n=7 f=3 cluster whose
 COMMIT-phase verification runs through the batching engine.
 
+Extras schema (the full dict lands in BENCH_extras.json; the printed
+bench_extras line carries the headline-grade subset):
+  {scheme}_verifies_per_sec / _ms_per_batch / _compile_s   device kernels
+  {scheme}_signs_per_sec                                   sign kernels
+  {prefix}_committed_req_per_sec (+ _stddev, _runs,
+      _req_per_sec_at_p50_500ms, latency percentiles)      e2e configs
+  {prefix}_{queue}_prep_share                              host-prep share
+      of each device queue's dispatch time in that e2e config
+      (VerifyStats.host_prep_time_s / device_time_s — the prep/device
+      stage split; ~0 means the pipeline is device-bound, ->1 host-bound)
+  prep_batch, {scheme}_prep_items_per_sec,
+      {scheme}_prep_scalar_items_per_sec, {scheme}_prep_speedup
+      host batch-prep microbench: vectorized prepare_batch vs the
+      per-item scalar oracle on the same host (bench_prep)
+
 Environment knobs:
   MINBFT_BENCH_BATCH        ECDSA batch size (default 32768)
   MINBFT_BENCH_REQUESTS     end-to-end request count (default 10000)
@@ -288,6 +303,78 @@ def bench_ed25519_sign(batch: int, mode: str = "block") -> dict:
         "ed25519_sign_batch": batch,
         "ed25519_signs_per_sec": batch / dt,
         "ed25519_sign_compile_s": round(compile_s, 1),
+    }
+
+
+def bench_prep(batch: int = 16384, ed_batch: int = 4096) -> dict:
+    """Host batch-prep microbench (round-6): the vectorized
+    ``prepare_batch`` (ONE Montgomery batch inversion per batch +
+    whole-batch numpy limb packing/range checks) against the per-item
+    scalar oracle on the same host, plus a bit-identity check of the
+    packed outputs.  Pure host work — backend-independent, so the batch
+    is NOT clamped in CPU SIM mode.
+
+    Items are synthetic but in-range (random coordinates < p, scalars in
+    [1, n-1], random digests): prep performs identical work for genuine
+    and forged signatures by design, and distinct values keep the big-int
+    multiply chain honest."""
+    import random
+
+    from minbft_tpu.ops import ed25519 as ed
+    from minbft_tpu.ops import p256
+    from minbft_tpu.utils import hostcrypto as hc
+
+    rng = random.Random(0x5EED)
+    items = [
+        (
+            (rng.randrange(p256.P), rng.randrange(p256.P)),
+            rng.randbytes(32),
+            (rng.randrange(1, p256.N), rng.randrange(1, p256.N)),
+        )
+        for _ in range(batch)
+    ]
+    vec = p256.pack_arrays(p256.prepare_batch(items))
+    oracle = p256.pack_arrays(p256.prepare_batch_scalar(items))
+    assert np.array_equal(vec, oracle), "vectorized prep != scalar oracle"
+
+    def best_of(fn, n_iter=3):
+        best = float("inf")
+        for _ in range(n_iter):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    tv = best_of(lambda: p256.prepare_batch(items))
+    ts = best_of(lambda: p256.prepare_batch_scalar(items))
+
+    # Ed25519: one real key (the cache-hit production shape — a cluster's
+    # key set is small), synthetic 64-byte signatures with s < L.
+    seed, pub = hc.ed25519_keygen(b"\x07" * 32)
+    del seed
+    ed_items = [
+        (
+            pub,
+            rng.randbytes(32),
+            rng.randbytes(32) + rng.randrange(ed.L).to_bytes(32, "little"),
+        )
+        for _ in range(ed_batch)
+    ]
+    ed_vec = ed.prepare_packed(ed_items, ed_batch)
+    ed_oracle = ed.pack_arrays(ed.prepare_batch_scalar(ed_items, ed_batch))
+    assert np.array_equal(ed_vec, ed_oracle), "ed25519 prep != oracle"
+    ed_tv = best_of(lambda: ed.prepare_batch(ed_items, ed_batch))
+    ed_ts = best_of(lambda: ed.prepare_batch_scalar(ed_items, ed_batch))
+
+    return {
+        "prep_batch": batch,
+        "ecdsa_prep_items_per_sec": round(batch / tv, 1),
+        "ecdsa_prep_scalar_items_per_sec": round(batch / ts, 1),
+        "ecdsa_prep_speedup": round(ts / tv, 2),
+        "ed25519_prep_batch": ed_batch,
+        "ed25519_prep_items_per_sec": round(ed_batch / ed_tv, 1),
+        "ed25519_prep_scalar_items_per_sec": round(ed_batch / ed_ts, 1),
+        "ed25519_prep_speedup": round(ed_ts / ed_tv, 2),
     }
 
 
@@ -841,11 +928,20 @@ async def _bench_cluster(
     for e in {id(e): e for e in engines}.values():
         for name, st in e.stats.items():
             agg = batch_stats.setdefault(
-                name, {"items": 0, "batches": 0, "memo_hits": 0}
+                name,
+                {
+                    "items": 0,
+                    "batches": 0,
+                    "memo_hits": 0,
+                    "host_prep_time_s": 0.0,
+                    "device_time_s": 0.0,
+                },
             )
             agg["items"] += st.items
             agg["batches"] += st.batches
             agg["memo_hits"] += st.memo_hits
+            agg["host_prep_time_s"] += st.host_prep_time_s
+            agg["device_time_s"] += st.device_time_s
     usig_queue = "hmac_sha256" if usig_kind == "hmac" else "ecdsa_p256"
     sig_stats = batch_stats.get("ed25519") if scheme == "ed25519" else None
 
@@ -915,6 +1011,17 @@ async def _bench_cluster(
             if sig_stats
             else {}
         ),
+        # Prep/device stage split (round-6): host share of each device
+        # queue's dispatch time — VerifyStats.host_prep_time_s over
+        # device_time_s (the whole dispatch await).  Host queues never
+        # populate host_prep_time_s, so only device queues emit a key.
+        **{
+            f"{prefix}_{name}_prep_share": round(
+                s["host_prep_time_s"] / s["device_time_s"], 4
+            )
+            for name, s in batch_stats.items()
+            if s["device_time_s"] > 0 and s["host_prep_time_s"] > 0
+        },
     }
 
 
@@ -1020,6 +1127,10 @@ def main() -> None:
         n_requests = min(n_requests, 500)
 
     extras.update(bench_hmac())
+    # Host batch-prep microbench (round-6 acceptance: >=5x over the scalar
+    # oracle at B=16384, bit-identical packed arrays) — host-only work, so
+    # it runs at full size on every backend.
+    extras.update(bench_prep())
     # Headline mode "block" (see ops/lowering.py): measured both faster
     # (122.8k vs 102.8k verifies/s at batch 4096 on v5e) and ~10x cheaper
     # to compile (42s vs ~7min) than the fully unrolled form.
@@ -1233,6 +1344,9 @@ def main() -> None:
         "mean_batch",
         "logical_verifies",
         "memo_hits",
+        "prep_share",
+        "prep_speedup",
+        "prep_items_per_sec",
         "backend",
     )
     compact = {

@@ -397,6 +397,9 @@ class Handlers:
         self._snapshot_sources: list = []  # claimants left to try
         self._snapshot_timer = None
         self._pending_new_view: Optional[NewView] = None
+        # Strong refs to fire-and-forget background tasks (the deferred
+        # NEW-VIEW re-check): discarded by their done-callback.
+        self._bg_tasks: set = set()
         self._logsize = getattr(configer, "logsize", 0)
         # Truncation requires state transfer to exist: dropping/stubbing
         # covered history strands any replica that later needs it unless
@@ -455,9 +458,15 @@ class Handlers:
                 # installing.  Applying advances the view, which drains
                 # the read lease this execution path runs under — so the
                 # re-check must run as its own task, outside the lease.
-                asyncio.get_running_loop().create_task(
+                # The event loop holds only a WEAK reference to running
+                # tasks (ADVICE r5): keep a strong one until done, and
+                # route the deliberately re-raised apply failure to the
+                # log instead of the unretrieved-exception void.
+                task = asyncio.get_running_loop().create_task(
                     self._maybe_apply_pending_new_view()
                 )
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._on_bg_task_done)
 
         self._prepare_batcher = _PrepareBatcher(
             replica_id,
@@ -1161,6 +1170,18 @@ class Handlers:
             await self.view_state.advance_current_view(resp.view)
         await self._maybe_apply_pending_new_view()
         return True
+
+    def _on_bg_task_done(self, task) -> None:
+        """Done-callback for fire-and-forget background tasks: drop the
+        strong reference and surface any failure in the replica log (the
+        task has no awaiter — without this its exception only appears as
+        an unretrieved-task warning at interpreter teardown, if ever)."""
+        self._bg_tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            self.log.error("background new-view apply failed: %r", exc)
 
     async def _maybe_apply_pending_new_view(self) -> None:
         """Retry a NEW-VIEW that was deferred behind a state transfer.

@@ -210,15 +210,17 @@ def _neg_pub_limbs(pub: bytes):
     return to_limbs((P - x) % P if x else 0), to_limbs(y)
 
 
-def prepare_batch(
+_ZERO64 = b"\x00" * 64
+_L_WORDS = limbs.words_of(L)
+_P_WORDS = limbs.words_of(P)
+
+
+def prepare_batch_scalar(
     items: Sequence[Tuple[bytes, bytes, bytes]], bucket: int
 ) -> Tuple[np.ndarray, ...]:
-    """[(pub32, msg, sig64)] -> device-ready limb arrays, padded to
-    ``bucket`` lanes.  Malformed/non-canonical inputs get valid=False.
-
-    Per-item host work is one SHA-512 and limb packing; the only big-int
-    sqrt (A's decompression) is cached per public key, and R is shipped
-    in its encoded form (see module docstring)."""
+    """Per-item reference prep — the differential ORACLE for the
+    vectorized :func:`prepare_batch` (kept verbatim, selectable via
+    MINBFT_SCALAR_PREP=1)."""
     import hashlib
 
     b = bucket
@@ -257,6 +259,89 @@ def prepare_batch(
     return ax, ay, u1, u2, ry, rsign, valid
 
 
+def prepare_batch(
+    items: Sequence[Tuple[bytes, bytes, bytes]], bucket: int
+) -> Tuple[np.ndarray, ...]:
+    """[(pub32, msg, sig64)] -> device-ready limb arrays, padded to
+    ``bucket`` lanes.  Malformed/non-canonical inputs get valid=False.
+
+    Vectorized (round-6, same division of labor as
+    :func:`minbft_tpu.ops.p256.prepare_batch`): the only remaining
+    per-item host work is one SHA-512 (64-bit ops — pointless to batch on
+    host or emulate on device) and the per-public-key decompression
+    cache.  Everything else is whole-batch numpy: the signature's s and
+    R-encoding halves are '<u2' views of the concatenated sig bytes (the
+    16-bit limb layout IS the wire layout), the s < L / y_r < p
+    canonicality checks are vectorized word compares, and the only
+    inversion-bearing prep (A's decompression sqrt) stays cached per key
+    — the sign path's compression already batch-inverts
+    (:func:`minbft_tpu.ops.limbs.batch_inv_host`).  Bit-identical to
+    :func:`prepare_batch_scalar`.
+    """
+    if limbs.SCALAR_PREP:
+        return prepare_batch_scalar(items, bucket)
+    import hashlib
+
+    b = bucket
+    n = len(items)
+    nl = limbs.NLIMBS
+    ax = np.zeros((b, nl), np.uint32)
+    ay = np.zeros((b, nl), np.uint32)
+    u1 = np.zeros((b, nl), np.uint32)
+    u2 = np.zeros((b, nl), np.uint32)
+    ry = np.zeros((b, nl), np.uint32)
+    rsign = np.zeros((b,), np.uint32)
+    valid = np.zeros((b,), np.bool_)
+    if n == 0:
+        return ax, ay, u1, u2, ry, rsign, valid
+
+    # Pass 1 (per item): structural sig check + cached decompression.
+    sigbuf = bytearray()
+    a_rows: list = []
+    ok = np.zeros((n,), np.bool_)
+    for i, (pub, _msg, sig) in enumerate(items):
+        a_limbs = _neg_pub_limbs(pub) if len(sig) == 64 else None
+        if a_limbs is None:
+            sigbuf += _ZERO64
+            a_rows.append(None)
+            continue
+        sigbuf += sig
+        a_rows.append(a_limbs)
+        ok[i] = True
+
+    raw = bytes(sigbuf)
+    srows = np.frombuffer(raw, dtype="<u2").reshape(n, 2, nl)
+    swords = np.frombuffer(raw, dtype="<u8").reshape(n, 2, 4)
+    ry16 = srows[:, 0].copy()
+    rsign_n = (ry16[:, nl - 1] >> 15).astype(np.uint32)
+    ry16[:, nl - 1] &= 0x7FFF  # y_r = y_enc & (2^255 - 1)
+
+    # Vectorized canonicality: s < L, y_r < p (strict semantics).
+    ok &= limbs.words_lt(swords[:, 1], _L_WORDS)
+    ok &= limbs.words_lt(limbs.limb_words(ry16), _P_WORDS)
+
+    # Pass 2 (valid lanes only): one SHA-512 per lane for the challenge k.
+    vidx = np.flatnonzero(ok)
+    idx = vidx.tolist()
+    if idx:
+        sha = hashlib.sha512
+        k_ints = []
+        for i in idx:
+            pub, msg, sig = items[i]
+            k_ints.append(
+                int.from_bytes(sha(sig[:32] + pub + msg).digest(), "little")
+                % L
+            )
+        ax[vidx] = np.stack([a_rows[i][0] for i in idx])
+        ay[vidx] = np.stack([a_rows[i][1] for i in idx])
+        u1[vidx] = srows[vidx, 1]
+        u2[vidx] = limbs.to_limbs_batch(k_ints)
+        ry[vidx] = ry16[vidx]
+        rsign[vidx] = rsign_n[vidx]
+        valid[vidx] = True
+    return ax, ay, u1, u2, ry, rsign, valid
+
+
 # Packed I/O (see ops/p256.py PACKED_COLS note): one u16 upload per
 # dispatch instead of seven array RPCs — limb values are 16-bit by
 # construction, rsign/valid are 0/1.
@@ -274,6 +359,28 @@ def pack_arrays(arrays) -> np.ndarray:
         ],
         axis=1,
     ).astype(np.uint16)
+
+
+def prepare_packed(
+    items: Sequence[Tuple[bytes, bytes, bytes]],
+    bucket: int,
+    out: "np.ndarray | None" = None,
+) -> np.ndarray:
+    """prepare_batch + pack_arrays fused into one [bucket, PACKED_COLS]
+    u16 staging write (see :func:`minbft_tpu.ops.p256.prepare_packed`);
+    ``out`` is an engine-owned recycled staging buffer."""
+    n = len(items)
+    out = limbs.staging_out(out, bucket, PACKED_COLS, n)
+    ax, ay, u1, u2, ry, rsign, valid = prepare_batch(items, bucket)
+    L_ = limbs.NLIMBS
+    out[:, 0:L_] = ax
+    out[:, L_ : 2 * L_] = ay
+    out[:, 2 * L_ : 3 * L_] = u1
+    out[:, 3 * L_ : 4 * L_] = u2
+    out[:, 4 * L_ : 5 * L_] = ry
+    out[:, 5 * L_] = rsign
+    out[:, 5 * L_ + 1] = valid
+    return out
 
 
 def _verify_one_packed(row: jnp.ndarray) -> jnp.ndarray:
@@ -298,7 +405,7 @@ def verify_batch_padded(
 ) -> np.ndarray:
     """Engine dispatch hook: prepare on host, verify on device -> [bucket]
     bool (lanes past len(items) are padding).  Packed single-upload path."""
-    packed = pack_arrays(prepare_batch(items, bucket))
+    packed = prepare_packed(items, bucket)
     return np.asarray(ed25519_verify_kernel_packed(jnp.asarray(packed)))
 
 

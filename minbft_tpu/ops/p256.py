@@ -13,7 +13,10 @@ Division of labor (TPU-first):
 - **Host** hashes variable-length bytes to the fixed 32-byte digest ``z``
   (:func:`minbft_tpu.messages.authen_digest`) and computes the two scalars
   ``u1 = z*s^-1 mod n`` and ``u2 = r*s^-1 mod n`` with native big-int ops —
-  cheap, and it keeps mod-n arithmetic off the device entirely.
+  cheap, and it keeps mod-n arithmetic off the device entirely.  The
+  per-batch cost is bounded by Montgomery batch inversion (ONE ``pow``
+  per batch — 3 big-int multiplies per lane) and whole-batch numpy limb
+  packing/range checks; see the "Host-side batch preparation" section.
 - **Device** does everything expensive: the 256-bit double-scalar
   multiplication ``u1*G + u2*Q`` (interleaved Shamir ladder, Jacobian
   coordinates, a = -3 doubling), one Fermat inversion to build the G+Q
@@ -263,17 +266,39 @@ _verify_batch = per_mode_jit(jax.vmap(_verify_one))
 
 # ---------------------------------------------------------------------------
 # Host-side batch preparation.
+#
+# Division of labor for the batch-inversion prep (round-6): the device
+# kernels were already fast enough that a 16384-lane batch was fed by a
+# SERIAL host loop doing one ~25us ``pow(s, -1, N)`` and six per-item
+# ``to_limbs`` list comprehensions per lane — the classic host-bound input
+# pipeline.  The vectorized ``prepare_batch`` below replaces that with
+#
+# - ONE modular inversion per batch: Montgomery batch inversion
+#   (:func:`minbft_tpu.ops.limbs.batch_inv_host` prefix-product sweep) —
+#   3 cheap big-int multiplies per item instead of a pow each;
+# - whole-batch limb packing: ints -> 32-byte little-endian -> one
+#   ``np.frombuffer`` as [B, 16] '<u2' (:func:`limbs.to_limbs_batch`);
+# - range validity (r, s in [1, n-1], coordinates < p, the r + n < p
+#   second-candidate window) as vectorized limb comparisons
+#   (:func:`limbs.limbs_lt`) feeding the kernel's ``valid`` lanes.
+#
+# ``prepare_batch_scalar`` keeps the original per-item path bit-for-bit as
+# the differential oracle (tests assert packed-array identity) and as a
+# runtime escape hatch (MINBFT_SCALAR_PREP=1).
+
+_ZERO128 = b"\x00" * 128  # one all-zero packed record (r | s | x | y)
+_N_WORDS = limbs.words_of(N)
+_P_WORDS = limbs.words_of(P)
+_PN_WORDS = limbs.words_of(P - N)  # r + n < p  <=>  r < p - n
 
 
-def prepare_batch(
+def prepare_batch_scalar(
     items: Sequence[Tuple[Tuple[int, int], bytes, Tuple[int, int]]],
 ) -> Tuple[np.ndarray, ...]:
-    """[(pubkey (x, y), digest32, (r, s))] -> device-ready limb arrays.
-
-    Host computes w = s^-1 mod n, u1 = z*w, u2 = r*w (mod n) with Python
-    big ints; out-of-range signatures get valid=False and dummy scalars so
-    the batch shape never changes.
-    """
+    """Per-item reference prep: one ``pow(s, -1, N)`` and six ``to_limbs``
+    per lane.  The differential ORACLE for the vectorized
+    :func:`prepare_batch` — kept verbatim, selectable via
+    MINBFT_SCALAR_PREP=1."""
     b = len(items)
     qx = np.zeros((b, limbs.NLIMBS), np.uint32)
     qy = np.zeros((b, limbs.NLIMBS), np.uint32)
@@ -297,6 +322,108 @@ def prepare_batch(
             r2[i] = to_limbs(r + N)
             r2_ok[i] = True
         valid[i] = True
+    return qx, qy, u1, u2, rr, r2, r2_ok, valid
+
+
+def prepare_batch(
+    items: Sequence[Tuple[Tuple[int, int], bytes, Tuple[int, int]]],
+) -> Tuple[np.ndarray, ...]:
+    """[(pubkey (x, y), digest32, (r, s))] -> device-ready limb arrays.
+
+    Host computes w = s^-1 mod n (ONE batch inversion for the whole
+    batch), u1 = z*w, u2 = r*w (mod n) with Python big ints, and packs /
+    range-checks the batch with vectorized numpy (see the section note
+    above).  Out-of-range signatures get valid=False and all-zero lanes so
+    the batch shape never changes.  Bit-identical to
+    :func:`prepare_batch_scalar`.
+    """
+    if limbs.SCALAR_PREP:
+        return prepare_batch_scalar(items)
+    b = len(items)
+    nl = limbs.NLIMBS
+    if b == 0:
+        z16 = np.zeros((0, nl), np.uint32)
+        zb = np.zeros((0,), np.bool_)
+        return z16, z16, z16, z16, z16, z16, zb, zb
+
+    # Pass 1 (per item, C-level): ints -> little-endian bytes.  Values
+    # outside [0, 2^256) cannot pack (to_bytes raises) — their lane is
+    # invalid regardless of the curve-order checks below, so pack zeros
+    # and mark unfit.
+    buf = bytearray()
+    unfit = []
+    for i, ((x, y), _digest, (r, s)) in enumerate(items):
+        try:
+            rec = (
+                r.to_bytes(32, "little")
+                + s.to_bytes(32, "little")
+                + x.to_bytes(32, "little")
+                + y.to_bytes(32, "little")
+            )
+        except (OverflowError, TypeError, AttributeError):
+            rec = _ZERO128
+            unfit.append(i)
+        buf += rec
+    raw = bytes(buf)
+    rows = np.frombuffer(raw, dtype="<u2").reshape(b, 4, nl)
+    words = np.frombuffer(raw, dtype="<u8").reshape(b, 4, 4)
+    rw, sw = words[:, 0], words[:, 1]
+
+    # Vectorized range validity: r, s in [1, n-1]; coordinates < p.
+    valid = (
+        rw.any(axis=1)
+        & limbs.words_lt(rw, _N_WORDS)
+        & sw.any(axis=1)
+        & limbs.words_lt(sw, _N_WORDS)
+        & limbs.words_lt(words[:, 2], _P_WORDS)
+        & limbs.words_lt(words[:, 3], _P_WORDS)
+    )
+    if unfit:
+        valid[unfit] = False
+
+    # Pass 2 (valid lanes only): ONE inversion for the batch, then 2
+    # multiplies per lane for the scalars.
+    all_valid = bool(valid.all())
+    idx = range(b) if all_valid else np.flatnonzero(valid).tolist()
+    ws = limbs.batch_inv_host([items[i][2][1] for i in idx], N)
+    u1_ints, u2_ints = [], []
+    for i, w in zip(idx, ws):
+        (_xy, digest, (r, _s)) = items[i]
+        z = int.from_bytes(digest[:32], "big") % N
+        u1_ints.append(z * w % N)
+        u2_ints.append(r * w % N)
+    if all_valid:
+        u1 = limbs.to_limbs_batch(u1_ints)
+        u2 = limbs.to_limbs_batch(u2_ints)
+    else:
+        u1 = np.zeros((b, nl), np.uint32)
+        u2 = np.zeros((b, nl), np.uint32)
+        if idx:
+            u1[idx] = limbs.to_limbs_batch(u1_ints)
+            u2[idx] = limbs.to_limbs_batch(u2_ints)
+
+    # Second x-candidate: r + n < p  <=>  r < p - n, so the window check
+    # needs no addition; the candidate itself is a vectorized limb add
+    # computed only over the (rare: r < ~2^224) lanes inside the window —
+    # no overflow there since r + n < p < 2^256.
+    r2_ok = valid & limbs.words_lt(rw, _PN_WORDS)
+    r2 = np.zeros((b, nl), np.uint32)
+    i2 = np.flatnonzero(r2_ok)
+    if len(i2):
+        r2[i2] = limbs.limbs_add_const(rows[i2, 0], N)
+
+    # Invalid lanes are all-zero in the oracle (its loop skips them
+    # before writing) — mask for bit-identical output.
+    if all_valid:
+        qx = rows[:, 2].astype(np.uint32)
+        qy = rows[:, 3].astype(np.uint32)
+        rr = rows[:, 0].astype(np.uint32)
+    else:
+        lane = valid[:, None]
+        z16 = np.uint16(0)
+        qx = np.where(lane, rows[:, 2], z16).astype(np.uint32)
+        qy = np.where(lane, rows[:, 3], z16).astype(np.uint32)
+        rr = np.where(lane, rows[:, 0], z16).astype(np.uint32)
     return qx, qy, u1, u2, rr, r2, r2_ok, valid
 
 
@@ -331,6 +458,32 @@ def pack_arrays(arrays) -> np.ndarray:
         ],
         axis=1,
     ).astype(np.uint16)
+
+
+def prepare_packed(
+    items: Sequence[Tuple[Tuple[int, int], bytes, Tuple[int, int]]],
+    bucket: int,
+    out: "np.ndarray | None" = None,
+) -> np.ndarray:
+    """prepare_batch + pack_arrays fused into one [bucket, PACKED_COLS]
+    u16 staging write.  ``out`` (engine-owned staging buffer, recycled
+    across dispatches) is written in place when given; padding the batch
+    to ``bucket`` is a tail slice-zero instead of materializing
+    ``list(items) + [PAD] * k`` and prepping the pad lanes."""
+    n = len(items)
+    out = limbs.staging_out(out, bucket, PACKED_COLS, n)
+    qx, qy, u1, u2, rr, r2, r2_ok, valid = prepare_batch(items)
+    L = limbs.NLIMBS
+    out[:n, 0:L] = qx
+    out[:n, L : 2 * L] = qy
+    out[:n, 2 * L : 3 * L] = u1
+    out[:n, 3 * L : 4 * L] = u2
+    out[:n, 4 * L : 5 * L] = rr
+    out[:n, 5 * L : 6 * L] = r2
+    out[:n, 6 * L] = r2_ok
+    out[:n, 6 * L + 1] = valid
+    out[n:] = 0
+    return out
 
 
 def _verify_one_packed(row: jnp.ndarray) -> jnp.ndarray:

@@ -65,12 +65,54 @@ class VerifyStats:
     max_batch_seen: int = 0
     padded_lanes: int = 0
     device_time_s: float = 0.0
+    # Host share of the dispatch: time the worker thread spent preparing
+    # and packing the batch (limb conversion, batch inversion, staging
+    # writes) BEFORE the kernel call — device_time_s covers the whole
+    # dispatch await, so host_prep_time_s / device_time_s is the prep
+    # share of the pipeline (bench.py reports it as *_prep_share).
+    host_prep_time_s: float = 0.0
     memo_hits: int = 0
     dispatch_timeouts: int = 0  # hung device dispatches rescued on host
 
     @property
     def mean_batch(self) -> float:
         return self.items / self.batches if self.batches else 0.0
+
+
+class _StagingPool:
+    """Recycled host staging buffers for the packed dispatch uploads.
+
+    Dispatchers run on worker threads — up to ``max_inflight`` of them
+    concurrently per scheme — so buffers are checked out under a lock and
+    returned only after the device results are materialized: a buffer is
+    never shared by two in-flight dispatches, and at steady state a
+    dispatch allocates nothing — prep writes limbs straight into a
+    recycled array and padding is a tail slice-zero instead of
+    ``list(items) + [PAD] * k`` re-prepping pad lanes every dispatch.
+    """
+
+    def __init__(self, cap: int = 8):
+        # ``cap`` bounds free buffers kept per (shape, dtype) — the engine
+        # passes its max_inflight (the most dispatches that can hold a
+        # buffer of one shape at once), so steady state never drops a
+        # recyclable buffer.
+        self._cap = max(2, cap)
+        self._lock = threading.Lock()
+        self._free: Dict[tuple, list] = {}
+
+    def acquire(self, shape: tuple, dtype) -> np.ndarray:
+        key = (shape, np.dtype(dtype).str)
+        with self._lock:
+            stack = self._free.get(key)
+            buf = stack.pop() if stack else None
+        return np.empty(shape, dtype) if buf is None else buf
+
+    def release(self, buf: np.ndarray) -> None:
+        key = (buf.shape, buf.dtype.str)
+        with self._lock:
+            stack = self._free.setdefault(key, [])
+            if len(stack) < self._cap:
+                stack.append(buf)
 
 
 class _SchemeQueue:
@@ -354,10 +396,11 @@ class BatchVerifier:
         self._sharded_kernels: Dict[str, object] = {}
         self._sharded_lock = threading.Lock()
         # Stats fields are owned per-field: the event loop owns the counts
-        # _run updates; padded_lanes is updated by the DISPATCHER, which
-        # runs on a worker thread (asyncio.to_thread) — and max_inflight
-        # of them can race the read-modify-write.  All padded_lanes
-        # updates go through this lock (tools/analyze lock-discipline
+        # _run updates; padded_lanes and host_prep_time_s are updated by
+        # the DISPATCHER, which runs on a worker thread
+        # (asyncio.to_thread) — and max_inflight of them can race the
+        # read-modify-write.  All dispatcher-side stats updates go through
+        # this lock via _note_prep (tools/analyze lock-discipline
         # enforces it).
         self._stats_lock = threading.Lock()
         self.max_batch = max_batch
@@ -389,12 +432,15 @@ class BatchVerifier:
         if self.mesh is not None:
             # Sharded kernels need every argument's batch axis divisible
             # by the mesh size (mesh.py documents the constraint) — round
-            # each bucket up to the next multiple.
-            sz = self.mesh.size
+            # each bucket up to the next multiple, which also keeps the
+            # staging-buffer shapes (keyed by bucket) on the contract.
+            from . import mesh as mesh_mod
+
             self.buckets = tuple(
-                sorted({-(-b // sz) * sz for b in self.buckets})
+                sorted({mesh_mod.round_up_to_mesh(self.mesh, b) for b in self.buckets})
             )
         self._queues: Dict[str, _SchemeQueue] = {}
+        self._staging = _StagingPool(cap=max_inflight)
 
     def _sharded(self, name: str, builder):
         # Dispatchers run on worker threads (max_inflight > 1): lock the
@@ -490,6 +536,22 @@ class BatchVerifier:
         return await q.submit((pub, msg, sig))
 
     # -- dispatchers (worker thread; jax work happens here) -----------------
+    #
+    # Shape: acquire a recycled staging buffer, prep/pack the batch into
+    # it (timed separately as host_prep_time_s — the prep/device split is
+    # a first-class measurement), dispatch the kernel, materialize the
+    # results, release the buffer.  The release MUST stay behind the
+    # result materialization: jax may still be reading the host buffer
+    # until the dispatch completes, and a released buffer can be
+    # re-acquired and overwritten by a concurrent dispatcher.
+
+    def _note_prep(self, name: str, pad: int, prep_s: float) -> None:
+        """Cross-thread stats update for a dispatcher (worker thread):
+        padded-lane and host-prep accounting under the stats lock."""
+        with self._stats_lock:
+            st = self._queues[name].stats
+            st.padded_lanes += pad
+            st.host_prep_time_s += prep_s
 
     def _dispatch_ecdsa(self, items) -> np.ndarray:
         import jax.numpy as jnp
@@ -501,18 +563,20 @@ class BatchVerifier:
         # Packed single-upload form: on tunnel-attached chips each array
         # is its own RPC and the 8-argument form paid 8 of them per
         # dispatch — the dominant share of the e2e dispatch round trip.
-        packed = p256.pack_arrays(
-            p256.prepare_batch(list(items) + [_ECDSA_PAD] * (b - n))
-        )
-        with self._stats_lock:
-            self._queues["ecdsa_p256"].stats.padded_lanes += b - n
-        if self.mesh is not None:
-            from . import mesh as mesh_mod
+        t0 = time.perf_counter()
+        staging = self._staging.acquire((b, p256.PACKED_COLS), np.uint16)
+        try:
+            packed = p256.prepare_packed(items, b, out=staging)
+            self._note_prep("ecdsa_p256", b - n, time.perf_counter() - t0)
+            if self.mesh is not None:
+                from . import mesh as mesh_mod
 
-            kernel = self._sharded("ecdsa", mesh_mod.sharded_ecdsa_kernel)
-            return np.asarray(kernel(packed))[:n]
-        out = p256.ecdsa_verify_kernel_packed(jnp.asarray(packed))
-        return np.asarray(out)[:n]
+                kernel = self._sharded("ecdsa", mesh_mod.sharded_ecdsa_kernel)
+                return np.asarray(kernel(packed))[:n]
+            out = p256.ecdsa_verify_kernel_packed(jnp.asarray(packed))
+            return np.asarray(out)[:n]
+        finally:
+            self._staging.release(staging)
 
     def _dispatch_hmac(self, items) -> np.ndarray:
         import jax.numpy as jnp
@@ -521,35 +585,48 @@ class BatchVerifier:
 
         n = len(items)
         b = _bucket_for(n, self.buckets)
-        packed = np.zeros((b, 24), np.uint32)
-        for i, (key, msg, mac) in enumerate(items):
-            packed[i, 0:8] = np.frombuffer(key, dtype=">u4").astype(np.uint32)
-            packed[i, 8:16] = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
-            packed[i, 16:24] = np.frombuffer(mac, dtype=">u4").astype(np.uint32)
-        with self._stats_lock:
-            self._queues["hmac_sha256"].stats.padded_lanes += b - n
-        if self.mesh is not None:
-            from . import mesh as mesh_mod
+        t0 = time.perf_counter()
+        staging = self._staging.acquire((b, 24), np.uint32)
+        try:
+            # One bulk big-endian word view of the concatenated batch
+            # instead of 3n per-item frombuffer calls.
+            staging[:n] = np.frombuffer(
+                b"".join([key + msg + mac for key, msg, mac in items]),
+                dtype=">u4",
+            ).reshape(n, 24)
+            staging[n:] = 0
+            self._note_prep("hmac_sha256", b - n, time.perf_counter() - t0)
+            if self.mesh is not None:
+                from . import mesh as mesh_mod
 
-            kernel = self._sharded("hmac", mesh_mod.sharded_hmac_kernel)
-            return np.asarray(kernel(packed))[:n]
-        out = hmac_verify_kernel_packed(jnp.asarray(packed))
-        return np.asarray(out)[:n]
+                kernel = self._sharded("hmac", mesh_mod.sharded_hmac_kernel)
+                return np.asarray(kernel(staging))[:n]
+            out = hmac_verify_kernel_packed(jnp.asarray(staging))
+            return np.asarray(out)[:n]
+        finally:
+            self._staging.release(staging)
 
     def _dispatch_ed25519(self, items) -> np.ndarray:
+        import jax.numpy as jnp
+
         from ..ops import ed25519 as ed
 
         n = len(items)
         b = _bucket_for(n, self.buckets)
-        with self._stats_lock:
-            self._queues["ed25519"].stats.padded_lanes += b - n
-        if self.mesh is not None:
-            from . import mesh as mesh_mod
+        t0 = time.perf_counter()
+        staging = self._staging.acquire((b, ed.PACKED_COLS), np.uint16)
+        try:
+            packed = ed.prepare_packed(items, b, out=staging)
+            self._note_prep("ed25519", b - n, time.perf_counter() - t0)
+            if self.mesh is not None:
+                from . import mesh as mesh_mod
 
-            kernel = self._sharded("ed25519", mesh_mod.sharded_ed25519_kernel)
-            packed = ed.pack_arrays(ed.prepare_batch(list(items), b))
-            return np.asarray(kernel(packed))[:n]
-        return ed.verify_batch_padded(list(items), b)[:n]
+                kernel = self._sharded("ed25519", mesh_mod.sharded_ed25519_kernel)
+                return np.asarray(kernel(packed))[:n]
+            out = ed.ed25519_verify_kernel_packed(jnp.asarray(packed))
+            return np.asarray(out)[:n]
+        finally:
+            self._staging.release(staging)
 
     # Host dispatchers: serial OpenSSL in the worker thread — no padding,
     # no device round trip; the queue layer still provides batching of the
@@ -584,7 +661,3 @@ class BatchVerifier:
             [hc.ed25519_verify(pub, msg, sig) for pub, msg, sig in items],
             dtype=bool,
         )
-
-
-# A structurally valid-but-failing pad item (valid=False lane).
-_ECDSA_PAD = ((0, 0), b"\x00" * 32, (0, 0))

@@ -39,6 +39,17 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(BATCH_AXIS))
 
 
+def round_up_to_mesh(mesh: Mesh, n: int) -> int:
+    """Smallest multiple of the mesh size >= n.
+
+    The batch-axis divisibility contract for every sharded kernel here:
+    bucket ladders AND the engine's staging buffers must pad to THIS (the
+    engine rounds its buckets through it at construction), or jit raises a
+    sharding error at dispatch time."""
+    sz = mesh.size
+    return -(-n // sz) * sz
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -55,6 +55,17 @@ def _write_frame(writer: asyncio.StreamWriter, data: bytes) -> None:
     writer.write(_LEN.pack(len(data)) + data)
 
 
+async def _close_writer(writer: asyncio.StreamWriter) -> None:
+    """Best-effort orderly close: close() only schedules the transport
+    teardown — wait_closed() lets the kernel flush/FIN before we drop the
+    last reference (bounded: shutdown must never hang on a dead peer)."""
+    try:
+        writer.close()
+        await asyncio.wait_for(writer.wait_closed(), 1.0)
+    except (Exception, asyncio.CancelledError):
+        pass
+
+
 class _TcpStreamHandler(api.MessageStreamHandler):
     """Dial side of one chat stream (one TCP connection per stream —
     mirrors gRPC's one-RPC-per-handle_message_stream shape)."""
@@ -103,10 +114,7 @@ class _TcpStreamHandler(api.MessageStreamHandler):
                 yield frame
         finally:
             pump.cancel()
-            try:
-                writer.close()
-            except Exception:
-                pass
+            await _close_writer(writer)
 
 
 class TcpReplicaConnector(api.ReplicaConnector):
@@ -175,14 +183,14 @@ class TcpReplicaServer:
         try:
             kind = await reader.readexactly(1)
         except (asyncio.IncompleteReadError, ConnectionError):
-            writer.close()
+            await _close_writer(writer)
             return
         if kind == PEER_KIND:
             handler = self._conn.peer_message_stream_handler()
         elif kind == CLIENT_KIND:
             handler = self._conn.client_message_stream_handler()
         else:
-            writer.close()
+            await _close_writer(writer)
             return
 
         async def incoming() -> AsyncIterator[bytes]:
@@ -203,10 +211,7 @@ class TcpReplicaServer:
             # closes this connection only.
             pass
         finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
+            await _close_writer(writer)
 
     async def start(self, address: str = "127.0.0.1:0") -> str:
         host, port = address.rsplit(":", 1)
@@ -217,8 +222,15 @@ class TcpReplicaServer:
         return f"{host}:{self.port}"
 
     async def stop(self, grace: float = 0.1) -> None:
+        """Stop listening, give live connection handlers ``grace`` seconds
+        to drain their streams (the gRPC server-contract semantics — a
+        handler mid-reply finishes instead of losing the frame), then
+        cancel whatever remains and wait for the sockets to close."""
         if self._server is not None:
-            self._server.close()
+            self._server.close()  # no NEW connections during the grace
+            live = [t for t in self._tasks if not t.done()]
+            if live and grace > 0:
+                await asyncio.wait(live, timeout=grace)
             for t in list(self._tasks):
                 t.cancel()
             if self._tasks:

@@ -267,6 +267,24 @@ def test_first_dispatch_gets_cold_compile_headroom():
     assert asyncio.run(scenario())
 
 
+def test_host_prep_time_populated_for_device_schemes():
+    """Round-6 prep/device split: every device dispatch accounts its host
+    prep (pack) time separately, so host_prep_time_s is non-zero whenever
+    a batch went through a device queue — the measurement bench.py turns
+    into *_prep_share."""
+
+    async def run():
+        eng = BatchVerifier(max_batch=8, buckets=(8,))
+        assert await eng.verify_hmac_sha256(*_hmac_item(0))
+        st = eng.stats["hmac_sha256"]
+        assert st.host_prep_time_s > 0.0
+        assert st.device_time_s > 0.0
+        # prep is a sub-interval of the dispatch the device clock wraps
+        assert st.host_prep_time_s <= st.device_time_s * 1.5 + 0.05
+
+    asyncio.run(run())
+
+
 def test_padded_lane_accounting_is_thread_safe():
     """Regression pin for the padded_lanes data race: dispatchers run on
     worker threads (up to max_inflight concurrently) and used to do a bare

@@ -156,3 +156,41 @@ def test_server_stop_with_live_connections_returns():
         await asyncio.sleep(30)
 
     assert asyncio.run(scenario())
+
+
+def test_server_stop_honors_grace_window():
+    """ADVICE r5: stop(grace) gives live handlers the grace window to
+    drain before cancellation (and still returns promptly after it), and
+    stop with no live connections skips the wait entirely."""
+
+    async def scenario():
+        server = TcpReplicaServer(_EchoConn())
+        addr = await server.start("127.0.0.1:0")
+        conn = TcpReplicaConnector("peer")
+        conn.connect_replica(0, addr)
+        h = conn.replica_message_stream_handler(0)
+        open_stream = h.handle_message_stream(_forever())
+        first = await asyncio.wait_for(open_stream.__anext__(), 10)
+        assert first == b"P:one"
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.wait_for(server.stop(grace=0.3), 10)
+        elapsed = loop.time() - t0
+        # the never-ending stream forces the full grace wait, then cancel
+        assert 0.25 <= elapsed < 5.0, elapsed
+        with pytest.raises(StopAsyncIteration):
+            await asyncio.wait_for(open_stream.__anext__(), 10)
+
+        # no live connections: grace adds no delay
+        server2 = TcpReplicaServer(_EchoConn())
+        await server2.start("127.0.0.1:0")
+        t0 = loop.time()
+        await asyncio.wait_for(server2.stop(grace=5.0), 10)
+        assert loop.time() - t0 < 1.0
+        return True
+
+    async def _forever():
+        yield b"one"
+        await asyncio.sleep(30)
+
+    assert asyncio.run(scenario())

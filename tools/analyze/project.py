@@ -211,10 +211,25 @@ def default_config() -> AnalyzeConfig:
                 # EXPLICIT, not "auto": inference learns guards from
                 # locked writes, so deleting every `with self._stats_lock`
                 # at once would silently un-guard the attribute.  These
-                # two pin the kernel memo and the cross-thread
-                # padded_lanes accounting (the round-1 race fix)
+                # pin the kernel memo and the cross-thread dispatcher
+                # stats accounting (padded_lanes: the round-1 race fix;
+                # host_prep_time_s: the round-6 prep/device split)
                 # regardless of what the code currently locks.
-                guarded=("_sharded_kernels", "_queues.stats.padded_lanes"),
+                guarded=(
+                    "_sharded_kernels",
+                    "_queues.stats.padded_lanes",
+                    "_queues.stats.host_prep_time_s",
+                ),
+                mode="threads",
+            ),
+            # The staging-buffer pool is checked out/returned from
+            # max_inflight worker threads concurrently: its free-list
+            # must only mutate under its lock.
+            LockClassSpec(
+                path="minbft_tpu/parallel/engine.py",
+                cls="_StagingPool",
+                locks=("_lock",),
+                guarded=("_free",),
                 mode="threads",
             ),
             LockClassSpec(
