@@ -12,16 +12,33 @@ Extras schema (the full dict lands in BENCH_extras.json; the printed
 bench_extras line carries the headline-grade subset):
   {scheme}_verifies_per_sec / _ms_per_batch / _compile_s   device kernels
   {scheme}_signs_per_sec                                   sign kernels
+  {scheme}_device_signs_per_sec (+ _sign_queue_mean_batch,
+      _sign_queue_fallback)     signing through the ENGINE SIGN QUEUE —
+      protocol-shaped concurrent submits, bucket padding, vectorized
+      host prep (bench_sign_queue; perf/SIGN_QUEUE.md).  On the CPU
+      backend the queue falls back to host signing and the fallback is
+      recorded — the key never silently reports host signs as device's.
   {prefix}_committed_req_per_sec (+ _stddev, _runs,
       _req_per_sec_at_p50_500ms, latency percentiles)      e2e configs
   {prefix}_{queue}_prep_share                              host-prep share
       of each device queue's dispatch time in that e2e config
       (VerifyStats.host_prep_time_s / device_time_s — the prep/device
       stage split; ~0 means the pipeline is device-bound, ->1 host-bound)
+  {prefix}_device_signs_per_sec, {prefix}_sign_share,
+      {prefix}_sign_fallback_items, {prefix}_queue_signs   per-config
+      REQUEST/REPLY signing through the sign queue: sign_share is the
+      device-signed fraction of queue-routed signatures (USIG UI signing
+      is serial by design and never counted here)
   prep_batch, {scheme}_prep_items_per_sec,
       {scheme}_prep_scalar_items_per_sec, {scheme}_prep_speedup
       host batch-prep microbench: vectorized prepare_batch vs the
       per-item scalar oracle on the same host (bench_prep)
+  tpu_unavailable, last_tpu   CPU-fallback honesty block: set whenever
+      the backend is CPU, with the newest committed real-TPU round's
+      numbers carried forward (see _last_tpu_numbers)
+  compile_cache_dir, compile_cache_entries_{before,after}   persistent
+      compile cache keyed to the kernel tree (utils/jaxcache.py): a warm
+      second run shows near-zero new entries and ~0 *_compile_s
 
 Environment knobs:
   MINBFT_BENCH_BATCH        ECDSA batch size (default 32768)
@@ -120,8 +137,14 @@ if os.environ.get("MINBFT_BENCH_SKIP_PREFLIGHT") != "1":
 
 import jax
 
-jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/minbft_jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+# Persistent compilation cache keyed to the kernel source tree (see
+# minbft_tpu/utils/jaxcache.py): a second run of the same tree should show
+# near-zero *_compile_s — the compile_cache_entries_{before,after} extras
+# prove whether this run compiled or loaded.
+from minbft_tpu.utils import jaxcache as _jaxcache
+
+_COMPILE_CACHE_DIR = _jaxcache.enable_compilation_cache()
+_COMPILE_CACHE_BEFORE = _jaxcache.entry_count(_COMPILE_CACHE_DIR)
 
 import jax.numpy as jnp
 import numpy as np
@@ -304,6 +327,80 @@ def bench_ed25519_sign(batch: int, mode: str = "block") -> dict:
         "ed25519_signs_per_sec": batch / dt,
         "ed25519_sign_compile_s": round(compile_s, 1),
     }
+
+
+async def _drive_sign_queue(eng, scheme: str, items, depth: int = 256) -> None:
+    """Drive the engine's sign queue the way the protocol does: many
+    concurrent awaiters, bounded in flight, each occupying its own lane
+    (the queue is memo-free — every sign is unique)."""
+    sem = asyncio.Semaphore(depth)
+    sign = eng.sign_ecdsa_p256 if scheme == "ecdsa" else eng.sign_ed25519
+
+    async def one(it):
+        async with sem:
+            await sign(*it)
+
+    await asyncio.gather(*[one(it) for it in items])
+
+
+def bench_sign_queue(n_items: int = 8192, bucket: int = 2048) -> dict:
+    """Signing throughput THROUGH the engine sign queue (not the raw
+    kernel — bench_ecdsa_sign covers that): concurrent submitters await
+    individual lanes, the queue ships fixed-bucket batches of k*G / r*B
+    to the comb kernels with vectorized host prep/finish.  This is the
+    number the protocol path sees; on the TPU backend it must clear the
+    ~907/s serial host floor (VERDICT round 5).
+
+    On the CPU backend the queue auto-falls-back to serial host signing
+    (sign_on_device resolves False); the keys still emit, with
+    ``*_sign_queue_fallback: true`` and the fallback item counts, so a
+    CPU number can never impersonate the chip's."""
+    from minbft_tpu.ops import lowering
+    from minbft_tpu.parallel import BatchVerifier
+    from minbft_tpu.parallel.engine import SignStats
+    from minbft_tpu.utils import hostcrypto as hc
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        n_items = min(n_items, 256)
+        bucket = min(bucket, 64)
+    out: dict = {}
+    lowering.set_mode("loop" if on_cpu else "block")
+    try:
+        for scheme, qname in (("ecdsa", "ecdsa_p256"), ("ed25519", "ed25519")):
+            eng = BatchVerifier(max_batch=bucket, buckets=(bucket,))
+            if scheme == "ecdsa":
+                d, _ = hc.keygen()
+                items = [
+                    (d, hashlib.sha256(b"sq-%d" % i).digest())
+                    for i in range(n_items)
+                ]
+            else:
+                seed, _ = hc.ed25519_keygen(hashlib.sha256(b"sq").digest())
+                items = [(seed, b"sq-%d" % i) for i in range(n_items)]
+            # Warm one full bucket through the queue: the comb-kernel
+            # compile lands off the clock, then reset the counters.
+            t0 = time.time()
+            asyncio.run(_drive_sign_queue(eng, scheme, items[:bucket]))
+            compile_s = time.time() - t0
+            for q in eng._sign_queues.values():
+                q.stats = SignStats()
+            t0 = time.time()
+            asyncio.run(_drive_sign_queue(eng, scheme, items))
+            dt = time.time() - t0
+            st = eng.sign_stats[qname]
+            assert st.items == n_items, (st.items, n_items)
+            out[f"{scheme}_device_signs_per_sec"] = round(n_items / dt, 1)
+            out[f"{scheme}_sign_queue_mean_batch"] = round(st.mean_batch, 1)
+            out[f"{scheme}_sign_queue_compile_s"] = round(compile_s, 1)
+            out[f"{scheme}_sign_queue_fallback"] = st.host_fallback_items > 0
+            if st.host_fallback_items:
+                out[f"{scheme}_sign_queue_host_fallback_items"] = (
+                    st.host_fallback_items
+                )
+    finally:
+        lowering.set_mode(None)
+    return out
 
 
 def bench_prep(batch: int = 16384, ed_batch: int = 4096) -> dict:
@@ -880,10 +977,13 @@ async def _bench_cluster(
     await asyncio.wait_for(clients[0].request(b"warmup"), timeout=600)
     # Warming polluted the engine counters with all-pad batches — reset so
     # the reported batch stats reflect protocol traffic only.
-    from minbft_tpu.parallel.engine import VerifyStats
+    from minbft_tpu.parallel.engine import SignStats, VerifyStats
 
     for q in shared._queues.values():
         q.stats = VerifyStats()
+    for e in {id(e): e for e in engines}.values():
+        for q in e._sign_queues.values():
+            q.stats = SignStats()
 
     per_client = n_requests // n_clients
     n_requests = per_client * n_clients
@@ -944,6 +1044,20 @@ async def _bench_cluster(
             agg["device_time_s"] += st.device_time_s
     usig_queue = "hmac_sha256" if usig_kind == "hmac" else "ecdsa_p256"
     sig_stats = batch_stats.get("ed25519") if scheme == "ed25519" else None
+
+    # Sign-queue stats (REQUEST/REPLY signatures routed through the
+    # engine's batch sign surface; USIG UI signing is serial by design and
+    # never appears here).  device items = items - host_fallback_items:
+    # on the CPU backend the queue transparently falls back to host
+    # signing and the split keeps the artifact honest.
+    sign_agg = {"items": 0, "fallback": 0, "prep_s": 0.0, "disp_s": 0.0}
+    for e in {id(e): e for e in engines}.values():
+        for _name, st in e.sign_stats.items():
+            sign_agg["items"] += st.items
+            sign_agg["fallback"] += st.host_fallback_items
+            sign_agg["prep_s"] += st.host_prep_time_s
+            sign_agg["disp_s"] += st.device_time_s
+    device_signs = sign_agg["items"] - sign_agg["fallback"]
 
     # Clients finish on f+1 matching replies; up to n-(f+1) replicas may
     # still be draining their pipelines.  Wait for convergence before the
@@ -1022,6 +1136,33 @@ async def _bench_cluster(
             for name, s in batch_stats.items()
             if s["device_time_s"] > 0 and s["host_prep_time_s"] > 0
         },
+        # Sign pipeline (this round): protocol-driven signs through the
+        # engine sign queue.  *_sign_share = fraction of queue-routed
+        # REQUEST/REPLY signatures that ran on the device kernels (1.0 on
+        # a healthy accelerator, 0.0 on the CPU fallback); the fallback
+        # count is always recorded so neither path can impersonate the
+        # other.  perf/SIGN_QUEUE.md explains the keys.
+        **(
+            {
+                f"{prefix}_device_signs_per_sec": round(device_signs / dt, 1),
+                f"{prefix}_sign_share": round(
+                    device_signs / sign_agg["items"], 4
+                ),
+                f"{prefix}_sign_fallback_items": sign_agg["fallback"],
+                f"{prefix}_queue_signs": sign_agg["items"],
+            }
+            if sign_agg["items"]
+            else {}
+        ),
+        **(
+            {
+                f"{prefix}_sign_prep_share": round(
+                    sign_agg["prep_s"] / sign_agg["disp_s"], 4
+                )
+            }
+            if sign_agg["disp_s"] > 0 and sign_agg["prep_s"] > 0
+            else {}
+        ),
     }
 
 
@@ -1107,6 +1248,66 @@ async def _bench_readonly(n=4, f=1, n_reads=4000, n_clients=16) -> dict:
             await r.stop()
 
 
+def _last_tpu_numbers() -> "dict | None":
+    """Carry-forward block for CPU-fallback runs: the newest committed
+    BENCH_r*.json produced on a real TPU backend, so a reader of this
+    round's artifact sees the chip's last known numbers next to the
+    honest CPU ones instead of mistaking one for the other (VERDICT
+    next-#1).  The driver files truncate their tails, so individual keys
+    are salvaged by regex when the embedded extras JSON is cut off."""
+    import glob
+    import re
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    carry_keys = (
+        "ecdsa_verifies_per_sec",
+        "ed25519_verifies_per_sec",
+        "hmac_verifies_per_sec",
+        "ecdsa_signs_per_sec",
+        "ecdsa_device_signs_per_sec",
+        "ed25519_device_signs_per_sec",
+        "e2e_committed_req_per_sec",
+        "mp_committed_req_per_sec",
+        "mptcp_committed_req_per_sec",
+    )
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")), reverse=True):
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        tail = rec.get("tail") or ""
+        parsed = rec.get("parsed") or {}
+        # A CPU-fallback round EMBEDS a last_tpu block of its own (with
+        # '"backend": "tpu"' inside it) — it must never be mistaken for
+        # a TPU round, or CPU numbers would be carried forward labeled
+        # as the chip's.  The tpu_unavailable stamp is the discriminator.
+        if parsed.get("tpu_unavailable") or '"tpu_unavailable": true' in tail:
+            continue
+        if parsed.get("backend") != "tpu" and '"backend": "tpu"' not in tail:
+            continue
+        block: dict = {"source": os.path.basename(path)}
+        if parsed:
+            block["headline"] = parsed
+        # Salvage only from BEFORE any nested carry-forward block, so a
+        # future artifact shape can't leak second-hand numbers in here.
+        scan = tail.split('"last_tpu"')[0]
+        m = re.search(r'\{"bench_extras": (\{.*?\})\}', scan)
+        if m:
+            try:
+                block["extras"] = json.loads(m.group(1))
+            except ValueError:
+                pass
+        for key in carry_keys:
+            m = re.search(rf'"{key}": ([0-9][0-9.e+]*)', scan)
+            if m:
+                block.setdefault("extras", {}).setdefault(
+                    key, float(m.group(1))
+                )
+        return block
+    return None
+
+
 def main() -> None:
     # Large batches amortize the per-dispatch overhead of remote-attached
     # chips (~13ms/launch on the tunneled bench host): measured 113k
@@ -1118,11 +1319,21 @@ def main() -> None:
     n_clients = int(os.environ.get("MINBFT_BENCH_CLIENTS", "100"))
 
     extras = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+    extras["compile_cache_dir"] = _COMPILE_CACHE_DIR
+    extras["compile_cache_entries_before"] = _COMPILE_CACHE_BEFORE
     if _BACKEND_FALLBACK is not None:
         # the intended accelerator backend was down; see stderr log
         extras["backend_fallback_from"] = _BACKEND_FALLBACK
     if jax.default_backend() == "cpu":
-        # SIM mode: keep shapes tiny so the bench still completes.
+        # SIM mode: keep shapes tiny so the bench still completes — and
+        # say so AT THE TOP LEVEL: every number below is a CPU number.
+        # The carry-forward block keeps the chip's last committed figures
+        # in view so nobody reads a CPU rate as the TPU's (VERDICT
+        # next-#1).
+        extras["tpu_unavailable"] = True
+        last = _last_tpu_numbers()
+        if last is not None:
+            extras["last_tpu"] = last
         batch = min(batch, 32)
         n_requests = min(n_requests, 500)
 
@@ -1146,6 +1357,12 @@ def main() -> None:
             big = bench_ecdsa_sign(batch, mode=mode)
             extras["ecdsa_sign_big_batch"] = big["ecdsa_sign_batch"]
             extras["ecdsa_sign_big_per_sec"] = big["ecdsa_signs_per_sec"]
+        # The sign QUEUE (this round's tentpole): the same kernels driven
+        # the way the protocol drives them — concurrent awaiters, bucket
+        # padding, vectorized host prep — emitting
+        # {ecdsa,ed25519}_device_signs_per_sec (vs the ~907/s serial
+        # host floor) with any CPU fallback recorded.
+        extras.update(bench_sign_queue())
     if not os.environ.get("MINBFT_BENCH_SKIP_ED25519"):
         extras.update(bench_ed25519(batch, mode=mode))
         extras.update(bench_ed25519_sign(min(batch, 8192), mode=mode))
@@ -1320,6 +1537,10 @@ def main() -> None:
             )
         )
 
+    extras["compile_cache_entries_after"] = _jaxcache.entry_count(
+        _COMPILE_CACHE_DIR
+    )
+
     value = ecdsa["ecdsa_verifies_per_sec"]
     # The FULL extras always land on disk (BENCH_r03's driver tail cut the
     # head off the one huge extras line and lost the flagship number);
@@ -1339,6 +1560,8 @@ def main() -> None:
         "verifies_per_sec",
         "signs_per_sec",
         "sign_big_per_sec",
+        "sign_share",
+        "sign_queue_fallback",
         "request_latency_p50_ms",
         "request_latency_p99_ms",
         "mean_batch",
@@ -1348,6 +1571,9 @@ def main() -> None:
         "prep_speedup",
         "prep_items_per_sec",
         "backend",
+        "tpu_unavailable",
+        "last_tpu",
+        "compile_cache_entries",
     )
     compact = {
         k: extras[k] for k in sorted(extras) if any(p in k for p in keep)

@@ -61,6 +61,15 @@ class Authenticator(abc.ABC):
     the USIG counter must increment atomically).  ``verify`` is awaitable so
     implementations can batch many in-flight verifications into one TPU
     kernel dispatch (see minbft_tpu/parallel/engine.py).
+
+    ``generate_message_authen_tag_async`` is the batch-aware sign surface:
+    implementations that can co-batch many in-flight signatures (the
+    engine's sign queue over the fixed-base comb kernels) override it for
+    the CLIENT/REPLICA roles; the default delegates to the synchronous
+    path.  The USIG role must stay on the synchronous path in every
+    implementation — the UI counter is incremented only after the
+    certificate exists (reference usig/sgx/enclave/usig.c:66-69), an
+    inherently serial per-key discipline that batching would break.
     """
 
     @abc.abstractmethod
@@ -73,6 +82,14 @@ class Authenticator(abc.ABC):
         recipient-specific (a MAC-scheme REPLY is keyed to one client);
         -1 = everyone (signatures, MAC vectors over all replicas).
         Signature-scheme implementations ignore it."""
+
+    async def generate_message_authen_tag_async(
+        self, role: AuthenticationRole, msg: bytes, audience: int = -1
+    ) -> bytes:
+        """Awaitable tag generation for callers already running on the
+        event loop (client REQUEST signing, replica REPLY emission).
+        Default: the synchronous path, unchanged semantics."""
+        return self.generate_message_authen_tag(role, msg, audience)
 
     @abc.abstractmethod
     async def verify_message_authen_tag(
@@ -189,7 +206,13 @@ class RequestConsumer(abc.ABC):
         cannot guarantee intersection with a write quorum in a correct
         replica).  Optional — replicas whose consumer lacks it drop
         read-only requests, and the client falls back to an ordered
-        request."""
+        request.
+
+        Capability probing: the core uses :func:`consumer_supports_query`
+        — a consumer that DELEGATES query to a wrapped consumer (metrics
+        shims, access-control decorators) should set the
+        ``supports_query`` attribute explicitly, since the structural
+        did-you-override-it fallback cannot see through delegation."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support read-only queries"
         )
@@ -213,6 +236,25 @@ class RequestConsumer(abc.ABC):
         raise NotImplementedError(
             f"{type(self).__name__} does not support state snapshots"
         )
+
+
+def consumer_supports_query(consumer: "RequestConsumer") -> bool:
+    """Feature-probe a consumer's fast-read capability (ADVICE low-#3).
+
+    A ``supports_query`` attribute wins outright — that is how a
+    delegating wrapper (whose ``query`` override forwards to a wrapped
+    consumer) keeps the fast-read path, and how a consumer can
+    explicitly opt out.  Absent that, fall back to the structural probe:
+    did the class override :meth:`RequestConsumer.query` at all."""
+    flag = getattr(consumer, "supports_query", None)
+    if flag is not None:
+        return bool(flag)
+    meth = getattr(type(consumer), "query", None)
+    if meth is None:
+        # Duck-typed consumer (e.g. a __getattr__ delegator that never
+        # subclassed RequestConsumer): probe the instance.
+        return callable(getattr(consumer, "query", None))
+    return meth is not RequestConsumer.query
 
 
 class Replica(abc.ABC):

@@ -141,6 +141,11 @@ class Client:
         self._queues: Dict[int, asyncio.Queue] = {}
         self._tasks: list = []
         self._started = False
+        # Broadcast-order gate: ordered REQUESTs must hit the wire in seq
+        # order (see request()) even when their batch-signed signatures
+        # resolve out of order.  Holds the previous ordered request's
+        # "broadcast done" future.
+        self._send_gate: Optional[asyncio.Future] = None
         self._log = logging.getLogger(f"minbft_tpu.client.{client_id}")
 
     # -- connections --------------------------------------------------------
@@ -375,25 +380,51 @@ class Client:
                 raise ConnectionError("client stopped")
             self._seq += 1
             seq = self._seq
-            req = Request(
-                client_id=self.client_id,
-                seq=seq,
-                operation=operation,
-                read_mode=mode,
-            )
-            req.signature = self._auth.generate_message_authen_tag(
-                api.AuthenticationRole.CLIENT, authen_bytes(req)
-            )
-            pending = _PendingRequest(
-                seq,
-                self.f + 1,
-                asyncio.get_running_loop(),
-                read_only=bool(mode),
-            )
-            self._pending[seq] = pending
-            data = marshal(req)
-            pending.data = data
-            self._broadcast(data)
+            # Broadcast-order gate: replica-side retirement has
+            # watermark-jump semantics (executing seq k supersedes every
+            # lower seq of this client), so ordered REQUESTs must reach
+            # the wire in seq order.  Batch signing suspends between seq
+            # allocation and broadcast — without the gate, seq k+1's
+            # signature resolving first would broadcast it ahead of seq
+            # k and k could be superseded unexecuted.  Signing itself
+            # still co-batches: every pipelined request submits to the
+            # sign queue immediately; only the SEND waits for its
+            # predecessor's send.
+            prev_gate = self._send_gate
+            gate: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._send_gate = gate
+            try:
+                req = Request(
+                    client_id=self.client_id,
+                    seq=seq,
+                    operation=operation,
+                    read_mode=mode,
+                )
+                # Awaitable batch-aware signing: concurrent pipelined
+                # requests co-batch their signatures on the engine's sign
+                # queue (plain synchronous signing for engine-less
+                # authenticators).
+                req.signature = await self._auth.generate_message_authen_tag_async(
+                    api.AuthenticationRole.CLIENT, authen_bytes(req)
+                )
+                if prev_gate is not None and not prev_gate.done():
+                    await prev_gate
+                pending = _PendingRequest(
+                    seq,
+                    self.f + 1,
+                    asyncio.get_running_loop(),
+                    read_only=bool(mode),
+                )
+                self._pending[seq] = pending
+                data = marshal(req)
+                pending.data = data
+                self._broadcast(data)
+            finally:
+                # Always open the gate — a failed/cancelled sign must not
+                # wedge every later request (its seq simply goes unused;
+                # client seqs need not be dense).
+                if not gate.done():
+                    gate.set_result(None)
             try:
                 if self._retransmit_interval is not None:
                     return await self._await_with_retransmit(pending, data, timeout)
@@ -416,7 +447,7 @@ class Client:
             operation=operation,
             read_mode=1,
         )
-        req.signature = self._auth.generate_message_authen_tag(
+        req.signature = await self._auth.generate_message_authen_tag_async(
             api.AuthenticationRole.CLIENT, authen_bytes(req)
         )
         pending = _PendingRequest(

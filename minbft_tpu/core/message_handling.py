@@ -41,6 +41,7 @@ from ..messages import (
     Request,
     SnapshotReq,
     SnapshotResp,
+    UNICAST_LOG_MESSAGES,
     ViewChange,
     authen_bytes,
     drain_multi,
@@ -216,6 +217,20 @@ class Handlers:
                 utils.signing_role(msg), authen_bytes(msg), audience
             )
 
+        async def sign_message_async(msg) -> None:
+            # The awaitable sibling for hot-path emission (REPLY signing):
+            # concurrent executors co-batch their signatures on the
+            # engine's sign queue instead of each paying a serial host
+            # sign inline.  Control-plane messages (checkpoints,
+            # view-change votes, HELLO) keep the synchronous path — their
+            # rate never justifies a batch lane.  USIG certification is
+            # untouched either way: the authenticator routes the USIG
+            # role serially by design (counter-after-sign).
+            audience = msg.client_id if isinstance(msg, Reply) else -1
+            msg.signature = await authenticator.generate_message_authen_tag_async(
+                utils.signing_role(msg), authen_bytes(msg), audience
+            )
+
         async def verify_signature(msg) -> None:
             peer = msg.client_id if isinstance(msg, Request) else msg.replica_id
             role = utils.signing_role(msg)
@@ -242,6 +257,7 @@ class Handlers:
             return ui
 
         self.sign_message = sign_message
+        self.sign_message_async = sign_message_async
         self.verify_signature = verify_signature
         self.verify_ui = verify_ui
         self.assign_ui = usig_ui.make_ui_assigner(authenticator)
@@ -289,9 +305,7 @@ class Handlers:
                 self.log.info(
                     "prepare timeout: forwarding request to primary %d", primary
                 )
-                ulog = self.unicast_logs.get(primary)
-                if ulog is not None:
-                    ulog.append(req)
+                self._unicast_append(primary, req)
 
             self.client_states.client(req.client_id).start_prepare_timer(
                 timeout, on_expiry
@@ -365,10 +379,11 @@ class Handlers:
             self.pending,
             stop_timers,
             consumer,
-            sign_message,
+            sign_message_async,
             add_reply,
             log=self.log,
             metrics=self.metrics,
+            sign_message_sync=sign_message,
         )
 
         # Checkpointing (phase 1 + 2 — core/checkpoint.py): every
@@ -1021,6 +1036,22 @@ class Handlers:
         self._snapshot_sources = sources
         self._send_snapshot_req()
 
+    def _unicast_append(self, peer_id: int, msg) -> None:
+        """THE unicast-log append point.  Only kinds in
+        messages.UNICAST_LOG_MESSAGES may ride a unicast log — the
+        signed-HELLO replay-harmlessness invariant is defined next to
+        that tuple and holds only while every unicast kind is public,
+        individually authenticated content.  Route new unicast traffic
+        through here so the contract trips loudly, not silently."""
+        if not isinstance(msg, UNICAST_LOG_MESSAGES):
+            raise TypeError(
+                f"{type(msg).__name__} is not a unicast-log kind — see "
+                "messages.UNICAST_LOG_MESSAGES (HELLO replay invariant)"
+            )
+        ulog = self.unicast_logs.get(peer_id)
+        if ulog is not None:
+            ulog.append(msg)
+
     def _send_snapshot_req(self) -> None:
         expect = self._snapshot_expect
         if expect is None or not self._snapshot_sources:
@@ -1030,9 +1061,7 @@ class Handlers:
         self.metrics.inc("state_transfer_requests")
         req = SnapshotReq(replica_id=self.replica_id, count=expect.count)
         self.sign_message(req)
-        ulog = self.unicast_logs.get(via)
-        if ulog is not None:
-            ulog.append(req)
+        self._unicast_append(via, req)
 
         def on_expiry() -> None:
             if self._snapshot_expect is not None:
@@ -1075,9 +1104,7 @@ class Handlers:
             cert=cert,
         )
         self.sign_message(resp)
-        ulog = self.unicast_logs.get(req.replica_id)
-        if ulog is not None:
-            ulog.append(resp)
+        self._unicast_append(req.replica_id, resp)
         return True
 
     async def _process_snapshot_resp(self, resp: SnapshotResp) -> bool:
@@ -1448,7 +1475,10 @@ class Handlers:
         a correct replica — and otherwise falls back to an ordered
         request.  A consumer without query() support drops the request
         into the same fallback."""
-        if type(self.consumer).query is api.RequestConsumer.query:
+        # Feature probe, not an identity check on the method object: a
+        # delegating wrapper consumer advertises ``supports_query`` and
+        # keeps the fast-read path (api.consumer_supports_query).
+        if not api.consumer_supports_query(self.consumer):
             self.metrics.inc("readonly_unsupported")
             return None
         error = False
@@ -1484,7 +1514,9 @@ class Handlers:
             read_only=True,
             error=error,
         )
-        self.sign_message(reply)
+        # Fast reads arrive many-at-once under load: co-batch their REPLY
+        # signatures on the sign queue like the ordered executor does.
+        await self.sign_message_async(reply)
         if not error:
             self.metrics.inc("readonly_served")
         return reply

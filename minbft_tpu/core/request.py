@@ -9,7 +9,8 @@ consumer and a signed REPLY is produced.
 
 from __future__ import annotations
 
-from typing import Awaitable, Callable
+import asyncio
+from typing import Awaitable, Callable, Dict
 
 from .. import api
 from ..messages import Reply, Request
@@ -88,15 +89,44 @@ def make_request_executor(
     pending_requests,
     stop_timers,
     consumer: api.RequestConsumer,
-    sign_message,
+    sign_message_async,
     add_reply,
     log=None,
     metrics=None,
+    sign_message_sync=None,
 ) -> Callable[[Request], Awaitable[None]]:
     """Execute a committed REQUEST exactly once (reference
     makeRequestExecutor, core/request.go:211-231): retire the seq (dedup),
     clear timers and pending state, deliver to the state machine, sign and
     buffer the REPLY.
+
+    ``sign_message_async`` is the AWAITABLE signer, and the REPLY is
+    signed OFF the execution chain: executions are strictly ordered
+    (commit.py ``_drain`` holds its exec lock across ``deliver``), so
+    awaiting a sign-queue round trip inline would serialize signature
+    latency into the chain and pin the sign batches at size 1.  Instead
+    each execution spawns its sign-and-buffer as a task and moves on —
+    consecutive executions co-batch their REPLY signatures on the
+    engine's sign queue (the DSig-style off-critical-path
+    restructuring).
+
+    BUFFERING stays in execution order even though SIGNING is concurrent:
+    each spawned task waits for its PER-CLIENT predecessor before
+    ``add_reply``.  ClientState.add_reply drops a lower seq arriving
+    after a higher one as a stale retry, so two concurrently in-flight
+    sign batches resolving out of order would otherwise permanently lose
+    the earlier REPLY (the client could never assemble its quorum for
+    that seq).  The chain is keyed by client_id — the stale-drop is a
+    per-client rule, and a global chain would let one hung sign batch
+    (90s dispatch timeout) delay every OTHER client's already-signed
+    replies.  It costs nothing in batching — every sign is already
+    submitted to the queue before any completion is awaited.
+
+    ``sign_message_sync`` is the serial emergency signer: if the batch
+    path fails (engine dispatch exception), the reply is re-signed
+    inline rather than silently dropped — a retransmitted REQUEST dedups
+    at retire_seq and can only RE-SERVE a buffered reply, never re-sign
+    a lost one.
 
     Returns True iff the request was actually delivered this call.  A
     re-proposed request re-drained after a view change early-returns False
@@ -104,6 +134,13 @@ def make_request_executor(
     must stay a deterministic global sequence number across replicas) must
     only count on True, or replicas that executed pre-transition would
     count a request twice while others count once."""
+    # Strong refs for the in-flight sign-and-buffer tasks (discarded by
+    # their done-callback) — a GC'd task would silently drop a REPLY.
+    sign_tasks: set = set()
+    # Per-client buffering-chain tails (see the docstring): execution
+    # order in, add_reply order out.  O(known clients) — same growth as
+    # the client_states map itself.
+    chain_tails: Dict[int, object] = {}
 
     async def execute_request(request: Request) -> bool:
         if not retire_seq(request):
@@ -154,8 +191,52 @@ def make_request_executor(
             read_only=request.is_read,
             error=error,
         )
-        sign_message(reply)
-        add_reply(reply)
+
+        prev = chain_tails.get(request.client_id)
+
+        async def sign_and_buffer() -> None:
+            signed = False
+            try:
+                await sign_message_async(reply)
+                signed = True
+            except Exception:
+                if log is not None:
+                    log.exception(
+                        "batched REPLY signing failed for client %d seq %d"
+                        "; re-signing serially",
+                        reply.client_id,
+                        reply.seq,
+                    )
+                if sign_message_sync is not None:
+                    try:
+                        sign_message_sync(reply)
+                        signed = True
+                    except Exception:
+                        # Both signers down: this reply is lost on this
+                        # replica (the other replicas' quorum carries the
+                        # client) — never the execution chain behind it.
+                        if log is not None:
+                            log.exception(
+                                "serial REPLY signing also failed for "
+                                "client %d seq %d",
+                                reply.client_id,
+                                reply.seq,
+                            )
+            if prev is not None:
+                # Buffer in execution order (see the factory docstring);
+                # a predecessor's failure or teardown-cancellation must
+                # not unbuffer THIS reply.
+                try:
+                    await prev
+                except (Exception, asyncio.CancelledError):
+                    pass
+            if signed:
+                add_reply(reply)
+
+        task = asyncio.get_running_loop().create_task(sign_and_buffer())
+        chain_tails[request.client_id] = task
+        sign_tasks.add(task)
+        task.add_done_callback(sign_tasks.discard)
         return True
 
     return execute_request

@@ -20,6 +20,7 @@ from .message import (
     REPLICA_MESSAGES,
     SIGNED_MESSAGES,
     UI,
+    UNICAST_LOG_MESSAGES,
     Commit,
     Hello,
     LogBase,
@@ -58,6 +59,7 @@ __all__ = [
     "PEER_MESSAGES",
     "CERTIFIED_MESSAGES",
     "SIGNED_MESSAGES",
+    "UNICAST_LOG_MESSAGES",
     "is_client_message",
     "is_peer_message",
     "marshal",

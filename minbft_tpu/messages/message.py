@@ -68,10 +68,10 @@ class Hello(Message):
     receiver verifies the replica signature over the claimed id before
     attaching the sender's unicast log, so an id-spoofing peer cannot
     subscribe to another replica's unicast stream.  A *replayed* signed
-    HELLO still subscribes the replayer — harmless by design: unicast logs
-    carry only signed/USIG-certified protocol messages (no confidentiality
-    claim), and log streams are replay-then-follow, so an extra subscriber
-    steals nothing from the genuine peer.
+    HELLO still subscribes the replayer — harmless, but only because of
+    the unicast-log CONTENT invariant pinned at
+    ``UNICAST_LOG_MESSAGES`` below: read that note before adding any
+    kind to a unicast log.
     """
 
     KIND = "HELLO"
@@ -392,6 +392,23 @@ CERTIFIED_MESSAGES = (Prepare, Commit, ViewChange, NewView)  # carry a USIG UI
 SIGNED_MESSAGES = (
     Request, Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp,
 )  # carry a plain signature
+
+# The kinds that may enter a per-peer UNICAST log (forwarded starved
+# REQUESTs and the state-transfer pair) — enforced at the core's append
+# sites (message_handling._unicast_append).
+#
+# Replay-harmlessness invariant (the reason a REPLAYED signed HELLO is
+# safe to serve — see Hello): every kind listed here is public protocol
+# content, individually signed or certificate-backed, with NO
+# confidentiality claim — so an extra unicast subscriber obtained by
+# replaying a peer's HELLO learns nothing and steals nothing (log streams
+# are replay-then-follow; the genuine peer keeps receiving).  This note
+# lives NEXT TO the content definition on purpose: if a unicast log ever
+# gains a kind carrying non-public content (a secret-bearing state
+# transfer, an unencrypted key share), the HELLO handshake must gain
+# replay protection (a challenge nonce) IN THE SAME CHANGE, or a replayed
+# HELLO becomes an exfiltration channel (ADVICE low-#2).
+UNICAST_LOG_MESSAGES = (Request, SnapshotReq, SnapshotResp)
 
 
 def is_peer_message(m: Message) -> bool:

@@ -504,39 +504,32 @@ def ed25519_rb_kernel(r_arr) -> jnp.ndarray:
 
 _batch_inv = limbs.batch_inv_host
 
+# Staging layout for the sign path (see ops/p256.py SIGN_COLS): one [16]
+# u16 nonce-limb row per lane, recyclable through the engine's pool.
+SIGN_COLS = limbs.NLIMBS
 
-def sign_batch(
+
+def sign_prepare(
     items: Sequence[Tuple[bytes, bytes]],
-    bucket: int = 0,
-    chunk: int = 4096,
-) -> list:
-    """[(seed32, msg)] -> [signature64] — RFC 8032 deterministic,
-    byte-identical to :func:`minbft_tpu.utils.hostcrypto.ed25519_sign`.
-    Device computes r*B (the comb); host derives the scalars (SHA-512),
-    batch-inverts the Zs for compression, and finishes s = r + k*a.
-
-    Shape discipline matches :func:`minbft_tpu.ops.p256.sign_batch`:
-    ``bucket`` pads to a fixed size, and anything larger is padded up to a
-    multiple of ``chunk`` (pad lanes compute 1*B and are discarded) so
-    varying batch sizes share compiled kernels — a fresh shape costs a
-    ~15s compile — while chunked launches pipeline the transfers."""
+    bucket: int,
+    out: "np.ndarray | None" = None,
+) -> Tuple[np.ndarray, tuple]:
+    """Host half 1 of batched Ed25519 signing: the RFC 8032 SHA-512
+    scalar derivations, with the whole batch's nonce limbs packed into
+    ``out`` (engine staging buffer when given) via one bulk conversion.
+    Pad lanes get r = 1 (valid, discarded).  Returns ``(staging, meta)``
+    for :func:`sign_finish`."""
     import hashlib
 
-    b = len(items)
-    if b == 0 and bucket == 0:
-        return []
-    total = max(bucket, b)
-    if total > chunk:
-        total = -(-total // chunk) * chunk
-    pad = total - b
+    n = len(items)
+    out = limbs.staging_out(out, bucket, SIGN_COLS, n)
     # Per-seed derivation cache: the production shape is ONE signer, many
     # messages — the SHA-512 seed expansion, clamp, and public key are
     # computed once per distinct seed, not per item.
     per_seed: dict = {}
     rs = []
-    meta = []
-    r_arr = np.zeros((total, limbs.NLIMBS), np.uint32)
-    for i, (seed, msg) in enumerate(items):
+    lanes = []
+    for seed, msg in items:
         entry = per_seed.get(seed)
         if entry is None:
             h = hashlib.sha512(seed).digest()
@@ -550,17 +543,25 @@ def sign_batch(
             % L
         )
         rs.append(r)
-        meta.append((a, pub, msg))
-        r_arr[i] = to_limbs(r)
-    if pad:
-        r_arr[b:, 0] = 1  # r = 1: a valid lane, result discarded
+        lanes.append((a, pub, msg))
+    if n:
+        out[:n] = limbs.to_limbs_batch(rs)
+    out[n:] = 0
+    out[n:, 0] = 1  # r = 1: a valid lane, result discarded
+    return out, (rs, lanes)
 
-    step = chunk if total > chunk else total
-    outs = [
-        ed25519_rb_kernel(r_arr[c0 : c0 + step])
-        for c0 in range(0, total, step)
-    ]
-    xyz = np.concatenate([np.asarray(o) for o in outs])[:b]  # [B,3,16] u16
+
+def sign_finish(meta: tuple, xyz) -> list:
+    """Host half 2: batch-invert the device Zs (ONE Montgomery sweep),
+    compress R, and finish s = r + k*a per lane (RFC 8032)."""
+    import hashlib
+
+    rs, lanes = meta
+    b = len(lanes)
+    xyz = np.concatenate([np.asarray(o) for o in xyz]) if isinstance(
+        xyz, (list, tuple)
+    ) else np.asarray(xyz)
+    xyz = xyz[:b]  # [B,3,16] u16
 
     # No Montgomery undo needed: the R factor cancels in the X/Z and Y/Z
     # ratios ((X*R) * (Z*R)^-1 == X/Z), so the raw device limbs feed the
@@ -571,7 +572,7 @@ def sign_batch(
     ]
     z_invs = _batch_inv([lane[2] for lane in ints], P)
     out = []
-    for i, (a, pub, msg) in enumerate(meta):
+    for i, (a, pub, msg) in enumerate(lanes):
         x, y, _z = ints[i]
         zi = z_invs[i]
         xa, ya = x * zi % P, y * zi % P
@@ -583,3 +584,34 @@ def sign_batch(
         s = (rs[i] + k * a) % L
         out.append(rp + s.to_bytes(32, "little"))
     return out
+
+
+def sign_batch(
+    items: Sequence[Tuple[bytes, bytes]],
+    bucket: int = 0,
+    chunk: int = 4096,
+    rb_kernel=None,
+) -> list:
+    """[(seed32, msg)] -> [signature64] — RFC 8032 deterministic,
+    byte-identical to :func:`minbft_tpu.utils.hostcrypto.ed25519_sign`.
+    Device computes r*B (the comb); host derives the scalars (SHA-512),
+    batch-inverts the Zs for compression, and finishes s = r + k*a —
+    :func:`sign_prepare` → r*B kernel → :func:`sign_finish`, the same
+    three stages the engine's sign queue drives with recycled staging.
+
+    Shape discipline matches :func:`minbft_tpu.ops.p256.sign_batch`:
+    ``bucket`` pads to a fixed size, and anything larger is padded up to a
+    multiple of ``chunk`` (pad lanes compute 1*B and are discarded) so
+    varying batch sizes share compiled kernels — a fresh shape costs a
+    ~15s compile — while chunked launches pipeline the transfers."""
+    b = len(items)
+    if b == 0 and bucket == 0:
+        return []
+    total = max(bucket, b)
+    if total > chunk:
+        total = -(-total // chunk) * chunk
+    r_arr, meta = sign_prepare(items, total)
+    kernel = rb_kernel if rb_kernel is not None else ed25519_rb_kernel
+    step = chunk if total > chunk else total
+    outs = [kernel(r_arr[c0 : c0 + step]) for c0 in range(0, total, step)]
+    return sign_finish(meta, outs)

@@ -664,6 +664,88 @@ def ecdsa_kg_kernel(k_arr) -> jnp.ndarray:
 
 _batch_inv = limbs.batch_inv_host
 
+# Staging layout for the sign path: one [16] u16 nonce-limb row per lane
+# (the k*G kernels upload u16 and widen on device).  The engine's sign
+# queue recycles [bucket, SIGN_COLS] buffers through its _StagingPool
+# exactly like the verify path's packed uploads.
+SIGN_COLS = limbs.NLIMBS
+
+
+def sign_prepare(
+    items: Sequence[Tuple[int, bytes]],
+    bucket: int,
+    out: "np.ndarray | None" = None,
+) -> Tuple[np.ndarray, list]:
+    """Host half 1 of batched signing: derive the RFC 6979 nonce per item
+    (an HMAC-SHA256 chain — inherently per-item, but cheap host hashing)
+    and pack the whole batch's nonce limbs with one bulk '<u2' view
+    (:func:`minbft_tpu.ops.limbs.to_limbs_batch`) into ``out`` (an
+    engine-owned recycled staging buffer when given).  Pad lanes get
+    k = 1 — a valid scalar whose result is discarded — as a tail write,
+    never a re-derivation.  Returns ``(staging, meta)``; ``meta`` is the
+    per-lane ``(d, z, k)`` list :func:`sign_finish` consumes."""
+    from ..utils import hostcrypto as hc
+
+    n = len(items)
+    out = limbs.staging_out(out, bucket, SIGN_COLS, n)
+    meta = []
+    ks = []
+    for d, digest in items:
+        z = int.from_bytes(digest[:32], "big") % N
+        k = hc._rfc6979_k(d, z)
+        meta.append((d, z, k))
+        ks.append(k)
+    if n:
+        out[:n] = limbs.to_limbs_batch(ks)
+    out[n:] = 0
+    out[n:, 0] = 1  # k = 1: a valid lane, result discarded
+    return out, meta
+
+
+def sign_finish(
+    items: Sequence[Tuple[int, bytes]], meta: list, xz
+) -> list:
+    """Host half 2: turn the device's [B, 2, 16] X/Z limbs into (r, s).
+
+    ONE Montgomery batch inversion each for the Z^2 chain (mod p) and the
+    nonces (mod n) — 3 big-int multiplies per lane instead of a ~25us
+    ``pow`` each (the PR-2 ``batch_inv_host`` machinery).  Exceptional
+    lanes (Z == 0) and the vanishing-probability r == 0 / s == 0 RFC 6979
+    retries fall back to the serial host signer per lane."""
+    from ..utils import hostcrypto as hc
+
+    b = len(meta)
+    xz = np.concatenate([np.asarray(o) for o in xz]) if isinstance(
+        xz, (list, tuple)
+    ) else np.asarray(xz)
+    xz = xz.astype("<u2")[:b]  # [B,2,16]
+    # Vectorized limb→int: uint16 rows → little-endian bytes → one
+    # int.from_bytes per row (a per-limb shift-sum costs ~250us/row).
+    x_ints = [int.from_bytes(row.tobytes(), "little") for row in xz[:, 0]]
+    z_ints = [int.from_bytes(row.tobytes(), "little") for row in xz[:, 1]]
+
+    r_inv = pow(1 << 256, -1, P)  # undo the Montgomery factor on host
+    valid = [i for i in range(b) if z_ints[i] != 0]
+    zj = {i: z_ints[i] * r_inv % P for i in valid}
+    zz_invs = dict(
+        zip(valid, _batch_inv([zj[i] * zj[i] % P for i in valid], P))
+    )
+    k_invs = dict(zip(valid, _batch_inv([meta[i][2] for i in valid], N)))
+
+    out = []
+    for i, (d, z, k) in enumerate(meta):
+        if i not in zz_invs:  # infinity / exceptional lane: serial fallback
+            out.append(hc.ecdsa_sign_py(d, items[i][1]))
+            continue
+        x_aff = (x_ints[i] * r_inv % P) * zz_invs[i] % P
+        r = x_aff % N
+        s = k_invs[i] * (z + r * d) % N
+        if r == 0 or s == 0:  # vanishing-probability RFC 6979 retry path
+            out.append(hc.ecdsa_sign_py(d, items[i][1]))
+            continue
+        out.append((r, s))
+    return out
+
 
 def sign_batch(
     items: Sequence[Tuple[int, bytes]],
@@ -680,9 +762,12 @@ def sign_batch(
     the verify path's engine buckets.  ``kg_kernel`` overrides the k*G
     kernel — pass :func:`minbft_tpu.parallel.mesh.sharded_ecdsa_sign_kernel`'s
     result to shard signing across a device mesh (bucket must then be a
-    multiple of the mesh size)."""
-    from ..utils import hostcrypto as hc
+    multiple of the mesh size).
 
+    Composition of :func:`sign_prepare` → k*G kernel → :func:`sign_finish`
+    — the engine's sign queue (:mod:`minbft_tpu.parallel.engine`) drives
+    the same three stages with recycled staging buffers and a separately
+    timed host/device split."""
     b = len(items)
     if b == 0 and bucket == 0:
         return []
@@ -695,47 +780,11 @@ def sign_batch(
     # chunk shapes share one compiled kernel.
     if total > chunk:
         total = -(-total // chunk) * chunk  # round up to a chunk multiple
-    pad = total - b
-    ks = []
-    k_arr = np.zeros((total, limbs.NLIMBS), np.uint32)
-    for i, (d, digest) in enumerate(items):
-        z = int.from_bytes(digest[:32], "big") % N
-        k = hc._rfc6979_k(d, z)
-        ks.append((d, z, k))
-        k_arr[i] = to_limbs(k)
-    if pad:
-        k_arr[b:, 0] = 1  # k = 1: a valid lane, result discarded
+    k_arr, meta = sign_prepare(items, total)
     kernel = kg_kernel if kg_kernel is not None else ecdsa_kg_kernel
     step = chunk if total > chunk else total
     outs = [kernel(k_arr[c0 : c0 + step]) for c0 in range(0, total, step)]
-    xz = np.concatenate([np.asarray(o) for o in outs]).astype("<u2")
-    xz = xz[:b]  # [B,2,16]
-    # Vectorized limb→int: uint16 rows → little-endian bytes → one
-    # int.from_bytes per row (a per-limb shift-sum costs ~250us/row).
-    x_ints = [int.from_bytes(row.tobytes(), "little") for row in xz[:, 0]]
-    z_ints = [int.from_bytes(row.tobytes(), "little") for row in xz[:, 1]]
-
-    r_inv = pow(1 << 256, -1, P)  # undo the Montgomery factor on host
-    valid = [i for i in range(b) if z_ints[i] != 0]
-    zj = {i: z_ints[i] * r_inv % P for i in valid}
-    zz_invs = dict(
-        zip(valid, _batch_inv([zj[i] * zj[i] % P for i in valid], P))
-    )
-    k_invs = dict(zip(valid, _batch_inv([ks[i][2] for i in valid], N)))
-
-    out = []
-    for i, (d, z, k) in enumerate(ks):
-        if i not in zz_invs:  # infinity / exceptional lane: serial fallback
-            out.append(hc.ecdsa_sign_py(d, items[i][1]))
-            continue
-        x_aff = (x_ints[i] * r_inv % P) * zz_invs[i] % P
-        r = x_aff % N
-        s = k_invs[i] * (z + r * d) % N
-        if r == 0 or s == 0:  # vanishing-probability RFC 6979 retry path
-            out.append(hc.ecdsa_sign_py(d, items[i][1]))
-            continue
-        out.append((r, s))
-    return out
+    return sign_finish(items, meta, outs)
 
 
 def is_on_curve(x: int, y: int) -> bool:

@@ -9,6 +9,6 @@ engine coalesces them into fixed-shape batches dispatched to one XLA kernel
 (one chip) or a sharded mesh (many chips).
 """
 
-from .engine import BatchVerifier, VerifyStats
+from .engine import BatchVerifier, SignStats, VerifyStats
 
-__all__ = ["BatchVerifier", "VerifyStats"]
+__all__ = ["BatchVerifier", "SignStats", "VerifyStats"]

@@ -21,6 +21,18 @@ Here, each protocol task awaits ``BatchVerifier.verify_*`` and the engine:
 Quorum waits (reference core/commit.go:108-143's mutex-serialized collector)
 thereby become "await one batched verify result" — the BASELINE.json north
 star restructuring.
+
+Signing gets the mirror-image treatment (:class:`_SignQueue`): client
+REQUEST and replica REPLY signatures are awaitable batch lanes over the
+fixed-base comb kernels (ops/p256.py / ops/ed25519.py sign halves), with
+the cheap big-int nonce/inverse work vectorized on the host — moving
+signature generation off the request critical path (DSig, arxiv
+2406.07215) the same way verification already is.  The sign queues are
+memo-free (every sign is its own protocol event) and fall back to serial
+host signing whenever no healthy device exists — CPU backend, write-off,
+or a hung dispatch — with the fallback recorded in :class:`SignStats`.
+USIG UI signing deliberately never routes here (counter-after-sign is
+serial per key, ref usig.c:66-69).
 """
 
 from __future__ import annotations
@@ -79,6 +91,34 @@ class VerifyStats:
         return self.items / self.batches if self.batches else 0.0
 
 
+@dataclasses.dataclass
+class SignStats:
+    """Sign-queue counters — the sign-side sibling of :class:`VerifyStats`.
+
+    ``host_prep_time_s`` covers BOTH host halves of a dispatch (nonce
+    derivation + limb packing before the kernel, batch inversion + scalar
+    finish after it); ``device_time_s`` is the whole dispatch await, so
+    the difference is the kernel + transfer share.
+    ``host_fallback_items`` counts items signed by the serial host
+    fallback instead of the device — because the backend is CPU (sign
+    device auto-disabled), the device was written off, or a dispatch hung
+    past the timeout — so a bench artifact can never pass host signing
+    off as device throughput."""
+
+    items: int = 0
+    batches: int = 0
+    max_batch_seen: int = 0
+    padded_lanes: int = 0
+    device_time_s: float = 0.0
+    host_prep_time_s: float = 0.0
+    dispatch_timeouts: int = 0
+    host_fallback_items: int = 0
+
+    @property
+    def mean_batch(self) -> float:
+        return self.items / self.batches if self.batches else 0.0
+
+
 class _StagingPool:
     """Recycled host staging buffers for the packed dispatch uploads.
 
@@ -115,7 +155,218 @@ class _StagingPool:
                 stack.append(buf)
 
 
-class _SchemeQueue:
+class _DispatchQueue:
+    """Shared machinery of the verify and sign queues: ship-when-idle
+    flush scheduling, ``max_inflight`` worker dispatch, and the
+    hung-dispatch liveness net (timeout → host fallback → write-off →
+    out-of-band re-probe).  Subclasses own the pending/resolution policy:
+    :class:`_SchemeQueue` dedups (verification is a pure function),
+    :class:`_SignQueue` is memo-free by design.
+    """
+
+    _WRITE_OFF_AFTER = 3  # CONSECUTIVE hung dispatches before host-only
+    _REPROBE_AFTER = 600.0  # s before a written-off device is re-tried
+    # Cold kernel compiles (unrolled ECDSA/Ed25519 shapes take minutes on
+    # a cold cache) land inside the FIRST dispatch: give it headroom so a
+    # slow-but-healthy compile is not misread as a hung tunnel.
+    _FIRST_TIMEOUT_FACTOR = 4
+
+    def __init__(self, engine: "BatchVerifier", name: str, dispatch):
+        self.engine = engine
+        self.name = name
+        self.dispatch = dispatch  # List[item] -> per-lane results
+        self.pending: List[Tuple[object, asyncio.Future]] = []
+        self._flush_handle: Optional[asyncio.Handle] = None
+        self.inflight = 0
+        self._consecutive_timeouts = 0
+        self._device_written_off = False
+        self._device_ever_succeeded = False
+        self._written_off_at = 0.0
+        self._probing = False
+
+    # -- subclass hooks -----------------------------------------------------
+
+    def _fallback(self):
+        """Serial host dispatcher for this queue's items (None: no net)."""
+        raise NotImplementedError
+
+    def _device_enabled(self) -> bool:
+        """False routes every batch straight to the fallback without
+        arming the timeout machinery."""
+        return True
+
+    def _resolve(self, batch, results, fell_back: bool) -> None:
+        """Resolve a completed batch's futures (subclass policy)."""
+        raise NotImplementedError
+
+    def _resolve_error(self, batch, e: BaseException) -> None:
+        """Resolve a failed batch's futures with the failure."""
+        raise NotImplementedError
+
+    async def _run(self, batch) -> None:
+        """One dispatch: liveness-netted execution, shared accounting,
+        then the subclass's resolution policy.  The finally re-flush is
+        what implements flush-on-completion (accumulated items ship the
+        moment a dispatch slot frees up)."""
+        items = [it for it, _ in batch]
+        t0 = time.monotonic()
+        try:
+            results, fell_back = await self._dispatch_with_fallback(items)
+        except Exception as e:
+            self._resolve_error(batch, e)
+            return
+        finally:
+            # Loop-atomic: each _run task decrements exactly once, and
+            # inflight is only ever read/written between awaits on the
+            # event loop — no read-modify-write spans a suspension.
+            self.inflight -= 1  # noqa: LD001
+            if self.pending:
+                self._flush_now()
+        dt = time.monotonic() - t0
+        st = self.stats
+        st.items += len(batch)
+        st.batches += 1
+        st.max_batch_seen = max(st.max_batch_seen, len(batch))
+        st.device_time_s += dt
+        self._resolve(batch, results, fell_back)
+
+    # -- flush scheduling ---------------------------------------------------
+
+    def _schedule_flush(self, fut: asyncio.Future) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        if len(self.pending) >= self.engine.max_batch:
+            self._flush_now()
+        elif self.inflight == 0 and self._flush_handle is None:
+            # Device idle: flush on the next loop turn (after every
+            # already-runnable coroutine has had the chance to co-submit),
+            # optionally stretched by max_delay to coalesce more.
+            if self.engine.max_delay > 0:
+                self._flush_handle = loop.call_later(
+                    self.engine.max_delay, self._flush_now
+                )
+            else:
+                self._flush_handle = loop.call_soon(self._flush_now)
+        # else: a dispatch is in flight — accumulate; its completion flushes.
+        return fut
+
+    def _flush_now(self) -> None:
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        max_batch = self.engine.max_batch
+        while self.pending and self.inflight < self.engine.max_inflight:
+            batch = self.pending[:max_batch]
+            del self.pending[:max_batch]
+            self.inflight += 1
+            asyncio.get_running_loop().create_task(self._run(batch))
+
+    # -- dispatch with the liveness net -------------------------------------
+
+    async def _dispatch_with_fallback(self, items):
+        """Run the dispatcher with a liveness net: on remote-attached
+        chips the tunnel occasionally stalls indefinitely mid-dispatch,
+        and a hung kernel call would wedge the whole queue — every
+        protocol task awaiting a result, forever.  The per-item host path
+        computes the same function, so after ``dispatch_timeout`` the same
+        items are re-run on the HOST (serial — slow but certain) and the
+        hung thread is abandoned; repeated timeouts write the device off
+        for this queue entirely (every later batch goes straight to host)
+        rather than paying the timeout again and again.
+
+        Returns ``(results, used_fallback)`` — the flag rides WITH the
+        results so callers account items and fallbacks atomically at
+        resolution time (a flag on ``self`` would race concurrent
+        max_inflight dispatches across the awaits)."""
+        fallback = self._fallback()
+        timeout = self.engine.dispatch_timeout
+        if fallback is not None and not self._device_enabled():
+            # No healthy device for this queue (e.g. the sign queues on a
+            # CPU backend): the host path IS the path — no timeout arming,
+            # no write-off bookkeeping, fallback recorded in stats.  This
+            # gate deliberately outranks the timeout<=0 shortcut below:
+            # disabling the liveness net must not re-route sign batches
+            # onto a backend the auto-gate ruled out.
+            return await asyncio.to_thread(fallback, items), True
+        if fallback is None or timeout <= 0:
+            return await asyncio.to_thread(self.dispatch, items), False
+        if self._device_written_off:
+            # The write-off is a demotion, not a death sentence: after
+            # _REPROBE_AFTER a duplicate of this batch re-tries the device
+            # OUT-OF-BAND (one at a time — _probing gates) and restores
+            # the queue on success.  The live batch always goes straight
+            # to the fallback: a probe of a still-dead device must never
+            # hold protocol work hostage for its timeout.
+            due = time.monotonic() - self._written_off_at >= self._REPROBE_AFTER
+            if due and not self._probing:
+                self._probing = True
+                asyncio.get_running_loop().create_task(self._probe(list(items)))
+            return await asyncio.to_thread(fallback, items), True
+        if not self._device_ever_succeeded:
+            # Cold compile may be inside this dispatch — see
+            # _FIRST_TIMEOUT_FACTOR.
+            timeout *= self._FIRST_TIMEOUT_FACTOR
+        task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
+        try:
+            results = await asyncio.wait_for(asyncio.shield(task), timeout)
+            self._consecutive_timeouts = 0  # the device is healthy again
+            self._device_ever_succeeded = True
+            return results, False
+        except asyncio.TimeoutError:
+            # Abandon the hung thread; swallow whatever it eventually
+            # raises (an abandoned-task exception would otherwise spam
+            # "Task exception was never retrieved").
+            task.add_done_callback(
+                lambda t: t.exception() if not t.cancelled() else None
+            )
+            self.stats.dispatch_timeouts += 1
+            self._consecutive_timeouts += 1
+            if self._consecutive_timeouts >= self._WRITE_OFF_AFTER:
+                self._device_written_off = True
+                self._written_off_at = time.monotonic()
+            import logging
+
+            logging.getLogger("minbft.engine").error(
+                "%s device dispatch hung >%ss (%d consecutive%s): "
+                "running %d items on host",
+                self.name,
+                timeout,
+                self._consecutive_timeouts,
+                "; device written off" if self._device_written_off else "",
+                len(items),
+            )
+            return await asyncio.to_thread(fallback, items), True
+
+    async def _probe(self, items) -> None:
+        """Out-of-band re-probe of a written-off device with a duplicate
+        of a live batch (the duplicates' results are discarded — the live
+        batch resolved via the fallback).  Success restores the device
+        queue; failure re-arms the re-probe clock."""
+        import logging
+
+        task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(task), self.engine.dispatch_timeout
+            )
+            self._device_written_off = False
+            self._consecutive_timeouts = 0
+            self._device_ever_succeeded = True
+            logging.getLogger("minbft.engine").warning(
+                "%s device recovered on re-probe: restoring device queue",
+                self.name,
+            )
+        except asyncio.TimeoutError:
+            task.add_done_callback(
+                lambda t: t.exception() if not t.cancelled() else None
+            )
+            self._written_off_at = time.monotonic()
+        except Exception:
+            self._written_off_at = time.monotonic()
+        finally:
+            self._probing = False
+
+
+class _SchemeQueue(_DispatchQueue):
     """Pending verifications for one scheme, with ship-when-idle flush.
 
     Verification is a pure function of the item, and one engine typically
@@ -134,29 +385,16 @@ class _SchemeQueue:
     # because negative hits only matter for byzantine *retransmissions* of
     # the same bad item — there is no protocol reason to remember many.
     _NEG_MEMO_CAP = 512
-    _WRITE_OFF_AFTER = 3  # CONSECUTIVE hung dispatches before host-only
-    _REPROBE_AFTER = 600.0  # s before a written-off device is re-tried
-    # Cold kernel compiles (unrolled ECDSA/Ed25519 shapes take minutes on
-    # a cold cache) land inside the FIRST dispatch: give it headroom so a
-    # slow-but-healthy compile is not misread as a hung tunnel.
-    _FIRST_TIMEOUT_FACTOR = 4
 
     def __init__(self, engine: "BatchVerifier", name: str, dispatch):
-        self.engine = engine
-        self.name = name
-        self.dispatch = dispatch  # List[item] -> np.ndarray[bool]
-        self.pending: List[Tuple[object, asyncio.Future]] = []
-        self._flush_handle: Optional[asyncio.Handle] = None
-        self.inflight = 0
+        super().__init__(engine, name, dispatch)
         self.stats = VerifyStats()
         self._memo: "OrderedDict[object, bool]" = OrderedDict()
         self._neg_memo: "OrderedDict[object, bool]" = OrderedDict()
         self._inflight_futs: Dict[object, asyncio.Future] = {}
-        self._consecutive_timeouts = 0
-        self._device_written_off = False
-        self._device_ever_succeeded = False
-        self._written_off_at = 0.0
-        self._probing = False
+
+    def _fallback(self):
+        return self.engine._host_fallback_for(self.name)
 
     def submit(self, item) -> "asyncio.Future | _Resolved":
         if not self.engine.dedup:
@@ -194,55 +432,13 @@ class _SchemeQueue:
         self.pending.append((item, fut))
         return self._schedule_flush(fut)
 
-    def _schedule_flush(self, fut: asyncio.Future) -> asyncio.Future:
-        loop = asyncio.get_running_loop()
-        if len(self.pending) >= self.engine.max_batch:
-            self._flush_now()
-        elif self.inflight == 0 and self._flush_handle is None:
-            # Device idle: flush on the next loop turn (after every
-            # already-runnable coroutine has had the chance to co-submit),
-            # optionally stretched by max_delay to coalesce more.
-            if self.engine.max_delay > 0:
-                self._flush_handle = loop.call_later(
-                    self.engine.max_delay, self._flush_now
-                )
-            else:
-                self._flush_handle = loop.call_soon(self._flush_now)
-        # else: a dispatch is in flight — accumulate; its completion flushes.
-        return fut
+    def _resolve_error(self, batch, e: BaseException) -> None:
+        for it, _ in batch:
+            for fut in self._inflight_futs.pop(it, ()):
+                if not fut.done():
+                    fut.set_exception(e)
 
-    def _flush_now(self) -> None:
-        if self._flush_handle is not None:
-            self._flush_handle.cancel()
-            self._flush_handle = None
-        max_batch = self.engine.max_batch
-        while self.pending and self.inflight < self.engine.max_inflight:
-            batch = self.pending[:max_batch]
-            del self.pending[:max_batch]
-            self.inflight += 1
-            asyncio.get_running_loop().create_task(self._run(batch))
-
-    async def _run(self, batch) -> None:
-        items = [it for it, _ in batch]
-        t0 = time.monotonic()
-        try:
-            results = await self._dispatch_with_fallback(items)
-        except Exception as e:  # resolve all futures with the failure
-            for it, _ in batch:
-                for fut in self._inflight_futs.pop(it, ()):
-                    if not fut.done():
-                        fut.set_exception(e)
-            return
-        finally:
-            self.inflight -= 1
-            if self.pending:
-                self._flush_now()
-        dt = time.monotonic() - t0
-        st = self.stats
-        st.items += len(batch)
-        st.batches += 1
-        st.max_batch_seen = max(st.max_batch_seen, len(batch))
-        st.device_time_s += dt
+    def _resolve(self, batch, results, fell_back: bool) -> None:
         dedup = self.engine.dedup
         for (it, _), ok in zip(batch, results):
             ok = bool(ok)
@@ -256,101 +452,57 @@ class _SchemeQueue:
                     fut.set_result(ok)
         # Loop-confined trims: each popitem is atomic on the event loop
         # and the while re-checks after every one, so interleaving with a
-        # concurrent _run only trims more — no cross-await invariant.
+        # concurrent resolve only trims more — no cross-await invariant.
         while len(self._memo) > self._MEMO_CAP:
-            self._memo.popitem(last=False)  # noqa: LD001
+            self._memo.popitem(last=False)
         while len(self._neg_memo) > self._NEG_MEMO_CAP:
-            self._neg_memo.popitem(last=False)  # noqa: LD001
+            self._neg_memo.popitem(last=False)
 
-    async def _dispatch_with_fallback(self, items):
-        """Run the dispatcher with a liveness net: on remote-attached
-        chips the tunnel occasionally stalls indefinitely mid-dispatch,
-        and a hung kernel call would wedge the whole verification queue —
-        every protocol task awaiting a verdict, forever.  Verification is
-        a pure function, so after ``dispatch_timeout`` the same items are
-        re-verified on the HOST (serial OpenSSL — slow but certain) and
-        the hung thread is abandoned; repeated timeouts write the device
-        off for this queue entirely (every later batch goes straight to
-        host) rather than paying the timeout again and again."""
-        fallback = self.engine._host_fallback_for(self.name)
-        timeout = self.engine.dispatch_timeout
-        if fallback is None or timeout <= 0:
-            return await asyncio.to_thread(self.dispatch, items)
-        if self._device_written_off:
-            # The write-off is a demotion, not a death sentence: after
-            # _REPROBE_AFTER a duplicate of this batch re-tries the device
-            # OUT-OF-BAND (one at a time — _probing gates) and restores
-            # the queue on success.  The live batch always goes straight
-            # to the fallback: a probe of a still-dead device must never
-            # hold protocol verifications hostage for its timeout.
-            due = time.monotonic() - self._written_off_at >= self._REPROBE_AFTER
-            if due and not self._probing:
-                self._probing = True
-                asyncio.get_running_loop().create_task(self._probe(list(items)))
-            return await asyncio.to_thread(fallback, items)
-        if not self._device_ever_succeeded:
-            # Cold compile may be inside this dispatch — see
-            # _FIRST_TIMEOUT_FACTOR.
-            timeout *= self._FIRST_TIMEOUT_FACTOR
-        task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
-        try:
-            results = await asyncio.wait_for(asyncio.shield(task), timeout)
-            self._consecutive_timeouts = 0  # the device is healthy again
-            self._device_ever_succeeded = True
-            return results
-        except asyncio.TimeoutError:
-            # Abandon the hung thread; swallow whatever it eventually
-            # raises (an abandoned-task exception would otherwise spam
-            # "Task exception was never retrieved").
-            task.add_done_callback(
-                lambda t: t.exception() if not t.cancelled() else None
-            )
-            self.stats.dispatch_timeouts += 1
-            self._consecutive_timeouts += 1
-            if self._consecutive_timeouts >= self._WRITE_OFF_AFTER:
-                self._device_written_off = True
-                self._written_off_at = time.monotonic()
-            import logging
 
-            logging.getLogger("minbft.engine").error(
-                "%s device dispatch hung >%ss (%d consecutive%s): "
-                "verifying %d items on host",
-                self.name,
-                timeout,
-                self._consecutive_timeouts,
-                "; device written off" if self._device_written_off else "",
-                len(items),
-            )
-            return await asyncio.to_thread(fallback, items)
+class _SignQueue(_DispatchQueue):
+    """Pending signatures for one scheme — the sign-side mirror of
+    :class:`_SchemeQueue` (same ship-when-idle flush, bucket padding,
+    recycled staging, ``max_inflight`` workers, hung-dispatch fallback)
+    with the dedup shortcuts deliberately ABSENT: no memo, no in-flight
+    coalescing.  Every submission occupies its own lane — a sign is a
+    distinct protocol event under the caller's own key (two replicas
+    signing byte-identical REPLY content must each produce and account
+    for their own signature), so nothing here may short-circuit on item
+    equality.  Contrast the USIG, which must not batch at all: its
+    counter is incremented only after each certificate exists
+    (ref usig.c:66-69), an inherently serial per-key discipline — USIG
+    signing never reaches this queue.
+    """
 
-    async def _probe(self, items) -> None:
-        """Out-of-band re-probe of a written-off device with a duplicate
-        of a live batch (verification is pure; the duplicates' results are
-        discarded — the live batch resolved via the fallback).  Success
-        restores the device queue; failure re-arms the re-probe clock."""
-        import logging
+    def __init__(self, engine: "BatchVerifier", name: str, dispatch):
+        super().__init__(engine, name, dispatch)
+        self.stats = SignStats()
 
-        task = asyncio.ensure_future(asyncio.to_thread(self.dispatch, items))
-        try:
-            await asyncio.wait_for(
-                asyncio.shield(task), self.engine.dispatch_timeout
-            )
-            self._device_written_off = False
-            self._consecutive_timeouts = 0
-            self._device_ever_succeeded = True
-            logging.getLogger("minbft.engine").warning(
-                "%s device recovered on re-probe: restoring device queue",
-                self.name,
-            )
-        except asyncio.TimeoutError:
-            task.add_done_callback(
-                lambda t: t.exception() if not t.cancelled() else None
-            )
-            self._written_off_at = time.monotonic()
-        except Exception:
-            self._written_off_at = time.monotonic()
-        finally:
-            self._probing = False
+    def _fallback(self):
+        return self.engine._sign_fallback_for(self.name)
+
+    def _device_enabled(self) -> bool:
+        return self.engine._sign_device_enabled()
+
+    def submit(self, item) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self.pending.append((item, fut))
+        return self._schedule_flush(fut)
+
+    def _resolve_error(self, batch, e: BaseException) -> None:
+        for _, fut in batch:
+            if not fut.done():
+                fut.set_exception(e)
+
+    def _resolve(self, batch, results, fell_back: bool) -> None:
+        if fell_back:
+            # Accounted HERE, with items, so the two counters can never
+            # skew apart (e.g. across a bench warmup stats reset).
+            self.stats.host_fallback_items += len(batch)
+        for (_, fut), sig in zip(batch, results):
+            if not fut.done():
+                fut.set_result(sig)
 
 
 class BatchVerifier:
@@ -376,7 +528,18 @@ class BatchVerifier:
         mesh=None,
         dispatch_timeout: float = 90.0,
         dedup: bool = True,
+        sign_on_device: Optional[bool] = None,
     ):
+        # Sign-queue device placement.  None = auto: the device sign
+        # kernels (fixed-base comb k*G / r*B) only beat serial host
+        # OpenSSL on a real accelerator — on the CPU backend a sign batch
+        # would pad to a full comb-kernel compile for no win, so auto
+        # resolves to False there and every sign batch transparently runs
+        # the host fallback with the fallback recorded in SignStats
+        # (host_fallback_items).  Resolved lazily on first use (reading
+        # the backend initializes it); tests force True to exercise the
+        # device path on CPU.
+        self._sign_on_device = sign_on_device
         # dedup=False is a MEASUREMENT mode: every logical verification
         # occupies a device lane (no memo, no in-flight coalescing), so
         # reported device verifies/s equals protocol demand — see
@@ -440,6 +603,7 @@ class BatchVerifier:
                 sorted({mesh_mod.round_up_to_mesh(self.mesh, b) for b in self.buckets})
             )
         self._queues: Dict[str, _SchemeQueue] = {}
+        self._sign_queues: Dict[str, _SignQueue] = {}
         self._staging = _StagingPool(cap=max_inflight)
 
     def _sharded(self, name: str, builder):
@@ -465,6 +629,14 @@ class BatchVerifier:
             self._queues[name] = q  # noqa: LD001
         return q
 
+    def _sign_queue(self, name: str, dispatch) -> _SignQueue:
+        q = self._sign_queues.get(name)
+        if q is None:
+            q = _SignQueue(self, name, dispatch)
+            # Loop-side publish (see _queue): a GIL-atomic dict store.
+            self._sign_queues[name] = q  # noqa: LD001
+        return q
+
     def _host_fallback_for(self, name: str):
         """Serial host re-verification for a DEVICE queue's items (None
         for the host queues themselves — they cannot hang on a tunnel)."""
@@ -474,9 +646,38 @@ class BatchVerifier:
             "ed25519": self._dispatch_ed25519_host,
         }.get(name)
 
+    def _sign_fallback_for(self, name: str):
+        """Serial host signing for a sign queue's items — the write-off /
+        timeout / CPU-backend net.  OpenSSL-backed (hostcrypto picks the
+        fast path), so a written-off device degrades to the measured
+        ~900 signs/s host floor, never to pure-Python big-int signing."""
+        from ..utils import hostcrypto as hc
+
+        return {
+            "ecdsa_p256": lambda items: [
+                hc.ecdsa_sign(d, digest) for d, digest in items
+            ],
+            "ed25519": lambda items: [
+                hc.ed25519_sign(seed, msg) for seed, msg in items
+            ],
+        }.get(name)
+
+    def _sign_device_enabled(self) -> bool:
+        v = self._sign_on_device
+        if v is None:
+            import jax
+
+            v = jax.default_backend() != "cpu"
+            self._sign_on_device = v
+        return v
+
     @property
     def stats(self) -> Dict[str, VerifyStats]:
         return {name: q.stats for name, q in self._queues.items()}
+
+    @property
+    def sign_stats(self) -> Dict[str, SignStats]:
+        return {name: q.stats for name, q in self._sign_queues.items()}
 
     # -- public API ---------------------------------------------------------
 
@@ -535,6 +736,31 @@ class BatchVerifier:
             q = self._queue(name, dispatch)
         return await q.submit((pub, msg, sig))
 
+    # -- signing ------------------------------------------------------------
+    #
+    # The awaitable batch sign surface (DSig's off-critical-path signing
+    # restructured for TPU): protocol tasks await a lane, the queue ships
+    # fixed-bucket batches of k*G / r*B through the fixed-base comb
+    # kernels, and the cheap big-int scalar work (RFC 6979 / RFC 8032
+    # nonces, one Montgomery batch inversion per batch) stays on the
+    # host — see ops/p256.py sign_prepare/sign_finish.  USIG UI signing
+    # must NEVER route here: its counter is incremented only after the
+    # certificate exists (ref usig.c:66-69), a serial per-key discipline.
+
+    async def sign_ecdsa_p256(self, d: int, digest: bytes) -> Tuple[int, int]:
+        """Batch-sign ``digest`` under private scalar ``d`` -> (r, s).
+        RFC 6979 deterministic — byte-identical to
+        ``hostcrypto.ecdsa_sign_py`` on the device path; the host
+        fallback signs with OpenSSL (random nonce, equally valid)."""
+        q = self._sign_queue("ecdsa_p256", self._dispatch_sign_ecdsa)
+        return await q.submit((d, digest))
+
+    async def sign_ed25519(self, seed: bytes, msg: bytes) -> bytes:
+        """Batch-sign ``msg`` under ``seed`` -> 64-byte RFC 8032
+        signature (deterministic on every path)."""
+        q = self._sign_queue("ed25519", self._dispatch_sign_ed25519)
+        return await q.submit((seed, msg))
+
     # -- dispatchers (worker thread; jax work happens here) -----------------
     #
     # Shape: acquire a recycled staging buffer, prep/pack the batch into
@@ -550,6 +776,14 @@ class BatchVerifier:
         padded-lane and host-prep accounting under the stats lock."""
         with self._stats_lock:
             st = self._queues[name].stats
+            st.padded_lanes += pad
+            st.host_prep_time_s += prep_s
+
+    def _note_sign_prep(self, name: str, pad: int, prep_s: float) -> None:
+        """Sign-queue sibling of :meth:`_note_prep` (worker thread):
+        same lock, the SignStats of ``_sign_queues[name]``."""
+        with self._stats_lock:
+            st = self._sign_queues[name].stats
             st.padded_lanes += pad
             st.host_prep_time_s += prep_s
 
@@ -625,6 +859,66 @@ class BatchVerifier:
                 return np.asarray(kernel(packed))[:n]
             out = ed.ed25519_verify_kernel_packed(jnp.asarray(packed))
             return np.asarray(out)[:n]
+        finally:
+            self._staging.release(staging)
+
+    # Sign dispatchers: prep (host) → comb kernel (device) → finish
+    # (host), with the nonce-limb staging recycled through the pool and
+    # BOTH host halves timed into SignStats.host_prep_time_s.  The
+    # staging release stays behind the result materialization, exactly
+    # like the verify dispatchers.
+
+    def _dispatch_sign_ecdsa(self, items) -> list:
+        from ..ops import p256
+
+        n = len(items)
+        b = _bucket_for(n, self.buckets)
+        t0 = time.perf_counter()
+        staging = self._staging.acquire((b, p256.SIGN_COLS), np.uint16)
+        try:
+            k_arr, meta = p256.sign_prepare(items, b, out=staging)
+            prep = time.perf_counter() - t0
+            if self.mesh is not None:
+                from . import mesh as mesh_mod
+
+                kernel = self._sharded(
+                    "ecdsa_sign", mesh_mod.sharded_ecdsa_sign_kernel
+                )
+            else:
+                kernel = p256.ecdsa_kg_kernel
+            xz = np.asarray(kernel(k_arr))
+            t1 = time.perf_counter()
+            sigs = p256.sign_finish(items, meta, xz)
+            prep += time.perf_counter() - t1
+            self._note_sign_prep("ecdsa_p256", b - n, prep)
+            return sigs
+        finally:
+            self._staging.release(staging)
+
+    def _dispatch_sign_ed25519(self, items) -> list:
+        from ..ops import ed25519 as ed
+
+        n = len(items)
+        b = _bucket_for(n, self.buckets)
+        t0 = time.perf_counter()
+        staging = self._staging.acquire((b, ed.SIGN_COLS), np.uint16)
+        try:
+            r_arr, meta = ed.sign_prepare(items, b, out=staging)
+            prep = time.perf_counter() - t0
+            if self.mesh is not None:
+                from . import mesh as mesh_mod
+
+                kernel = self._sharded(
+                    "ed25519_sign", mesh_mod.sharded_ed25519_sign_kernel
+                )
+            else:
+                kernel = ed.ed25519_rb_kernel
+            xyz = np.asarray(kernel(r_arr))
+            t1 = time.perf_counter()
+            sigs = ed.sign_finish(meta, xyz)
+            prep += time.perf_counter() - t1
+            self._note_sign_prep("ed25519", b - n, prep)
+            return sigs
         finally:
             self._staging.release(staging)
 

@@ -144,3 +144,20 @@ def sharded_ecdsa_sign_kernel(mesh: Mesh):
         return p256._kg_comb_one(k.astype(jnp.uint32), table)
 
     return sharded_verifier(kg_one, mesh, 1)
+
+
+def sharded_ed25519_sign_kernel(mesh: Mesh):
+    """Batched fixed-base r*B (the device half of Ed25519 signing,
+    :func:`minbft_tpu.ops.ed25519.sign_batch`) sharded across ``mesh``:
+    [B, 16] nonce limbs in, [B, 3, 16] X/Y/Z limbs (uint16) out; the
+    comb table replicates as a compile-time constant per device."""
+    import jax.numpy as jnp
+
+    from ..ops import ed25519 as ed
+
+    table = jnp.asarray(ed._comb_table_np())
+
+    def rb_one(r):
+        return ed._rb_comb_one(r.astype(jnp.uint32), table)
+
+    return sharded_verifier(rb_one, mesh, 1)

@@ -45,8 +45,18 @@ class SigScheme:
     # Whether a TPU batch-verify kernel exists for this scheme; the
     # authenticator routes device-incapable schemes to the host path.
     device_capable = True
+    # Whether a device batch-SIGN kernel exists (the fixed-base comb
+    # k*G / r*B paths); schemes without one fall back to sync sign.
+    sign_capable = False
 
     def sign(self, priv, msg: bytes) -> bytes:
+        raise NotImplementedError
+
+    async def sign_async(self, priv, msg: bytes, engine) -> bytes:
+        """Awaitable signing through the engine's sign queue.  Only
+        defined for sign_capable schemes — the queue itself falls back to
+        serial host signing when no healthy device exists, so callers
+        never need a scheme-level device probe."""
         raise NotImplementedError
 
     async def verify(self, pub, msg: bytes, tag: bytes, engine, device=True) -> bool:
@@ -55,10 +65,16 @@ class SigScheme:
 
 class EcdsaScheme(SigScheme):
     name = "ecdsa-p256"
+    sign_capable = True
 
     def sign(self, priv: int, msg: bytes) -> bytes:
         digest = hashlib.sha256(msg).digest()
         r, s = hc.ecdsa_sign(priv, digest)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    async def sign_async(self, priv: int, msg: bytes, engine) -> bytes:
+        digest = hashlib.sha256(msg).digest()
+        r, s = await engine.sign_ecdsa_p256(priv, digest)
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     async def verify(
@@ -77,9 +93,13 @@ class EcdsaScheme(SigScheme):
 
 class Ed25519Scheme(SigScheme):
     name = "ed25519"
+    sign_capable = True
 
     def sign(self, priv: bytes, msg: bytes) -> bytes:
         return hc.ed25519_sign(priv, hashlib.sha256(msg).digest())
+
+    async def sign_async(self, priv: bytes, msg: bytes, engine) -> bytes:
+        return await engine.sign_ed25519(priv, hashlib.sha256(msg).digest())
 
     async def verify(
         self, pub: bytes, msg: bytes, tag: bytes, engine, device=True
@@ -167,6 +187,7 @@ class SampleAuthenticator(api.Authenticator):
         usig_ids: Optional[Dict[int, bytes]] = None,
         engine: Optional[BatchVerifier] = None,
         batch_signatures: bool = True,
+        batch_sign: bool = True,
         own_replica_id: Optional[int] = None,
     ):
         self._scheme = SCHEMES[scheme]
@@ -207,6 +228,14 @@ class SampleAuthenticator(api.Authenticator):
         # may disable it to exercise only the USIG batch path without
         # paying the big-kernel compile on the CPU SIM backend).
         self._batch_signatures = batch_signatures
+        # Route own CLIENT/REPLICA signing through the engine's sign
+        # queue (the awaitable batch sign surface).  Unlike
+        # batch_signatures this needs no placement judgement call: the
+        # queue itself resolves device-vs-host (sign_on_device auto-gates
+        # on the backend, write-off demotes a sick tunnel), so leaving it
+        # on is safe everywhere an engine exists.  USIG signing is
+        # unaffected by design — see generate_message_authen_tag_async.
+        self._batch_sign = batch_sign
 
     # -- generation ---------------------------------------------------------
 
@@ -226,6 +255,36 @@ class SampleAuthenticator(api.Authenticator):
                 raise api.AuthenticationError("no USIG")
             return self._usig.create_ui(msg).to_bytes()
         raise api.AuthenticationError(f"unknown role {role}")
+
+    async def generate_message_authen_tag_async(
+        self, role: api.AuthenticationRole, msg: bytes, audience: int = -1
+    ) -> bytes:
+        """Batch-aware signing: CLIENT/REPLICA tags of sign-capable
+        schemes join the engine's sign queue (an awaitable batch lane
+        over the comb kernels — host fallback inside the queue when no
+        device is healthy); everything else takes the synchronous path.
+
+        The USIG role ALWAYS signs serially: create_ui holds the counter
+        lock across certify-then-increment (reference usig.c:66-69) and
+        must keep doing so — batching UI creation would either reorder
+        counters against send order or serialize on the lock anyway.
+        Tests pin this boundary by asserting no sign-queue traffic from
+        USIG tag generation."""
+        if (
+            self._engine is not None
+            and self._batch_sign
+            and self._scheme.sign_capable
+            and role
+            in (api.AuthenticationRole.CLIENT, api.AuthenticationRole.REPLICA)
+        ):
+            priv = (
+                self._client_priv
+                if role == api.AuthenticationRole.CLIENT
+                else self._replica_priv
+            )
+            if priv is not None:
+                return await self._scheme.sign_async(priv, msg, self._engine)
+        return self.generate_message_authen_tag(role, msg, audience)
 
     # -- verification -------------------------------------------------------
 
@@ -470,6 +529,7 @@ def new_test_authenticators(
     engine: Optional[BatchVerifier] = None,
     engines: Optional[list] = None,
     batch_signatures: bool = True,
+    batch_sign: bool = True,
     client_engine: Optional[BatchVerifier] = None,
     tofu_anchors: bool = False,
 ):
@@ -509,6 +569,7 @@ def new_test_authenticators(
             usig_ids=usig_ids,
             engine=(engines[i] if engines else engine),
             batch_signatures=batch_signatures,
+            batch_sign=batch_sign,
             own_replica_id=i,
         )
         for i in range(n)
@@ -520,8 +581,11 @@ def new_test_authenticators(
             replica_pubs=replica_pubs,
             client_pubs=client_pubs,
             # Default None: clients verify replies serially (f+1 is small).
-            # Pass client_engine to co-batch REPLY verification on TPU.
+            # Pass client_engine to co-batch REPLY verification on TPU
+            # (it also carries the client's REQUEST signing through the
+            # sign queue when batch_sign is on).
             engine=client_engine,
+            batch_sign=batch_sign,
         )
         for i in range(n_clients)
     ]

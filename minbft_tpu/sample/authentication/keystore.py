@@ -468,7 +468,11 @@ class KeyStore:
         return {kid: self._decode_sig(self.client_keys, kid)[1] for kid in self.client_keys}
 
     def replica_authenticator(
-        self, replica_id: int, engine=None, batch_signatures: bool = True
+        self,
+        replica_id: int,
+        engine=None,
+        batch_signatures: bool = True,
+        batch_sign: bool = True,
     ) -> SampleAuthenticator:
         priv, _ = self._decode_sig(self.replica_keys, replica_id)
         if priv is None:
@@ -482,6 +486,7 @@ class KeyStore:
             usig_ids=self.usig_anchors(),
             engine=engine,
             batch_signatures=batch_signatures,
+            batch_sign=batch_sign,
             own_replica_id=replica_id,
         )
 

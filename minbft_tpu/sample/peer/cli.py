@@ -270,6 +270,11 @@ async def _run_replica(args) -> int:
     from ...core import new_replica
     from ...sample.authentication import KeyStore
     from ...sample.config import load_config
+    from ...utils import jaxcache
+
+    # Tree-keyed persistent compile cache: a restarted replica loads its
+    # kernels instead of recompiling them (set before any jax use).
+    jaxcache.enable_compilation_cache()
     if args.transport == "tcp":
         from ...sample.conn.tcp import (
             TcpReplicaConnector as GrpcReplicaConnector,

@@ -414,12 +414,16 @@ def test_request_executor_full_path_and_dedup():
             pending,
             c.sync("stop_timers"),
             Consumer(),
-            c.sync("sign"),
+            c.coro("sign"),  # the executor awaits the batch-aware signer
             replies.append,
         )
         r = _req(client_id=9, seq=4)
         await execute(r)
         await execute(r)  # duplicate: retire_seq false -> no effects
+        # REPLY signing runs off the execution chain (spawned task — see
+        # make_request_executor): drain it before asserting.
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
         return c, pending, delivered, replies, r
 
     c, pending, delivered, replies, r = run(scenario())

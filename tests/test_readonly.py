@@ -82,6 +82,87 @@ def test_fast_read_answers_without_ordering():
     asyncio.run(run())
 
 
+def test_supports_query_feature_probe():
+    """api.consumer_supports_query (ADVICE low-#3): explicit
+    ``supports_query`` wins; the structural did-you-override probe is
+    only the fallback, and duck-typed consumers don't crash it."""
+    from minbft_tpu.sample.requestconsumer import SimpleLedger
+
+    class NoQuery(api.RequestConsumer):
+        async def deliver(self, op):
+            return b""
+
+        def state_digest(self):
+            return b""
+
+    class OptOut(SimpleLedger):
+        supports_query = False
+
+    class DuckDelegator:
+        """Never subclasses RequestConsumer; forwards everything."""
+
+        supports_query = True
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    assert api.consumer_supports_query(SimpleLedger())
+    assert not api.consumer_supports_query(NoQuery())
+    assert not api.consumer_supports_query(OptOut())  # opt-out wins
+    assert api.consumer_supports_query(DuckDelegator(SimpleLedger()))
+
+
+def test_fast_read_survives_delegating_consumer_wrapper():
+    """A delegating wrapper consumer (metrics shim / access decorator)
+    must keep the fast-read path: the identity-based probe this replaces
+    either crashed on duck-typed wrappers or silently demoted every fast
+    read to the ordered fallback."""
+
+    class Delegator:
+        supports_query = True
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    async def run():
+        replicas, c_auths, stubs, ledgers = await _cluster()
+        for r in replicas:
+            r.handlers.consumer = Delegator(r.handlers.consumer)
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        await asyncio.wait_for(client.request(b"write-1"), 30)
+        for _ in range(100):
+            if all(lg.length == 1 for lg in ledgers):
+                break
+            await asyncio.sleep(0.02)
+        head = await asyncio.wait_for(
+            client.request(b"head", read_only=True), 30
+        )
+        assert struct.unpack(">Q", head[:8])[0] == 1
+        # every replica served the FAST path through the wrapper
+        assert all(
+            r.handlers.metrics.counters.get("readonly_served", 0) >= 1
+            for r in replicas
+        )
+        assert all(
+            r.handlers.metrics.counters.get("readonly_unsupported", 0) == 0
+            for r in replicas
+        )
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+
+
 def test_fast_read_falls_back_to_ordered_read_when_a_replica_is_down():
     """With one replica stopped the all-n fast quorum cannot form; the
     client falls back to an ORDERED read: linearized by consensus,

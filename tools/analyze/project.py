@@ -219,6 +219,11 @@ def default_config() -> AnalyzeConfig:
                     "_sharded_kernels",
                     "_queues.stats.padded_lanes",
                     "_queues.stats.host_prep_time_s",
+                    # The sign queues' dispatcher-side stats follow the
+                    # same rule: _note_sign_prep runs on max_inflight
+                    # worker threads and must hold _stats_lock.
+                    "_sign_queues.stats.padded_lanes",
+                    "_sign_queues.stats.host_prep_time_s",
                 ),
                 mode="threads",
             ),
@@ -237,6 +242,26 @@ def default_config() -> AnalyzeConfig:
                 cls="_SchemeQueue",
                 locks=(),
                 guarded=("pending", "_memo", "_neg_memo", "_inflight_futs"),
+            ),
+            # The flush machinery shared by the verify and sign queues:
+            # event-loop confined (dispatchers hop to threads via
+            # asyncio.to_thread).  Only the batching state is guarded —
+            # the write-off/probe counters are deliberately benign-racy
+            # (a stale read costs one extra probe or fallback batch,
+            # never correctness) and suspend-crossing writes to them are
+            # part of the design, exactly as in the pre-split
+            # _SchemeQueue.
+            LockClassSpec(
+                path="minbft_tpu/parallel/engine.py",
+                cls="_DispatchQueue",
+                locks=(),
+                guarded=("pending", "inflight", "_flush_handle"),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/parallel/engine.py",
+                cls="_SignQueue",
+                locks=(),
+                guarded=("pending",),
             ),
             # The software USIG's counter is certified-then-incremented
             # under a real threading.Lock (reference ecallLock).
