@@ -33,6 +33,7 @@ import time
 from typing import AsyncIterator, Dict, Optional
 
 from .. import api
+from ..obs import trace as obs_trace
 from ..utils.backoff import ReconnectBackoff
 from ..messages import (
     CodecError,
@@ -122,6 +123,7 @@ class Client:
         seq_start: Optional[int] = None,
         max_inflight: Optional[int] = None,
         retransmit_interval: Optional[float] = None,
+        trace: bool = False,
     ):
         if n < 2 * f + 1:
             raise ValueError(f"n must be at least 2f+1 (n={n}, f={f})")
@@ -146,6 +148,14 @@ class Client:
         # resolve out of order.  Holds the previous ordered request's
         # "broadcast done" future.
         self._send_gate: Optional[asyncio.Future] = None
+        # Flight recorder for the client-side spans (sign → broadcast →
+        # first-reply → f+1-quorum); one predicated check per hook when
+        # off (obs/trace.py).
+        self._trace = (
+            obs_trace.FlightRecorder.for_client(client_id)
+            if (trace or obs_trace.tracing_enabled())
+            else None
+        )
         self._log = logging.getLogger(f"minbft_tpu.client.{client_id}")
 
     # -- connections --------------------------------------------------------
@@ -174,6 +184,10 @@ class Client:
                 pending.result.set_exception(
                     ConnectionError("client stopped with the request in flight")
                 )
+        if self._trace is not None:
+            # No-op unless MINBFT_TRACE_DUMP is set (live-scrape-only
+            # recorders have nothing to flush).
+            obs_trace.dump_recorder(self._trace)
 
     async def _outgoing(self, q: asyncio.Queue) -> AsyncIterator[bytes]:
         # Coalesce a pipelined burst of requests into one transport
@@ -304,7 +318,17 @@ class Client:
         # Re-fetch: the request may have resolved/retired during the await.
         pending = self._pending.get(msg.seq)
         if pending is not None:
+            tr = self._trace
+            if tr is None:
+                pending.add_reply(msg)
+                return
+            first = not pending.replies_by_replica
+            was_done = pending.result.done()
             pending.add_reply(msg)
+            if first and pending.replies_by_replica:
+                tr.note(obs_trace.C_FIRST_REPLY, self.client_id, msg.seq)
+            if not was_done and pending.result.done():
+                tr.note(obs_trace.C_QUORUM, self.client_id, msg.seq)
 
     # -- requests -----------------------------------------------------------
 
@@ -393,6 +417,7 @@ class Client:
             prev_gate = self._send_gate
             gate: asyncio.Future = asyncio.get_running_loop().create_future()
             self._send_gate = gate
+            tr = self._trace
             try:
                 req = Request(
                     client_id=self.client_id,
@@ -400,6 +425,8 @@ class Client:
                     operation=operation,
                     read_mode=mode,
                 )
+                if tr is not None:
+                    tr.note(obs_trace.C_START, self.client_id, seq)
                 # Awaitable batch-aware signing: concurrent pipelined
                 # requests co-batch their signatures on the engine's sign
                 # queue (plain synchronous signing for engine-less
@@ -407,6 +434,8 @@ class Client:
                 req.signature = await self._auth.generate_message_authen_tag_async(
                     api.AuthenticationRole.CLIENT, authen_bytes(req)
                 )
+                if tr is not None:
+                    tr.note(obs_trace.C_SIGN, self.client_id, seq)
                 if prev_gate is not None and not prev_gate.done():
                     await prev_gate
                 pending = _PendingRequest(
@@ -419,6 +448,8 @@ class Client:
                 data = marshal(req)
                 pending.data = data
                 self._broadcast(data)
+                if tr is not None:
+                    tr.note(obs_trace.C_BROADCAST, self.client_id, seq)
             finally:
                 # Always open the gate — a failed/cancelled sign must not
                 # wedge every later request (its seq simply goes unused;
@@ -447,9 +478,14 @@ class Client:
             operation=operation,
             read_mode=1,
         )
+        tr = self._trace
+        if tr is not None:
+            tr.note(obs_trace.C_START, self.client_id, seq)
         req.signature = await self._auth.generate_message_authen_tag_async(
             api.AuthenticationRole.CLIENT, authen_bytes(req)
         )
+        if tr is not None:
+            tr.note(obs_trace.C_SIGN, self.client_id, seq)
         pending = _PendingRequest(
             seq, self.n, asyncio.get_running_loop(), read_only=True
         )
@@ -457,6 +493,8 @@ class Client:
         data = marshal(req)
         pending.data = data
         self._broadcast(data)
+        if tr is not None:
+            tr.note(obs_trace.C_BROADCAST, self.client_id, seq)
         try:
             return await asyncio.wait_for(pending.result, wait)
         finally:

@@ -75,9 +75,14 @@ class CommitmentCollector:
     each replica's CVs are sequential) implies f+1 distinct replicas
     committed this CV.  Nothing grows with the number of requests served."""
 
-    def __init__(self, f: int, execute_request, on_batch_end=None):
+    def __init__(self, f: int, execute_request, on_batch_end=None,
+                 trace_quorum=None):
         self._f = f
         self._execute = execute_request
+        # Flight-recorder COMMIT-QUORUM capture point (obs/trace.py):
+        # noted per request when its batch's quorum releases in order,
+        # immediately before execution.  None when tracing is off.
+        self._trace_quorum = trace_quorum
         # Fired after each batch finishes executing, with (view, cv) — a
         # deterministic global position, which is what lets checkpoints
         # (core/checkpoint.py) claim a comparable (count, view, cv) on
@@ -235,6 +240,9 @@ class CommitmentCollector:
                     return
                 # A batched prepare commits atomically: its requests execute
                 # back-to-back in batch order on every replica.
+                if self._trace_quorum is not None:
+                    for req in prepare.requests:
+                        self._trace_quorum(req)
                 for req in prepare.requests:
                     await self._execute(req)
                 if self._on_batch_end is not None:

@@ -59,6 +59,7 @@ from . import timeout as timeout_mod
 from . import checkpoint as checkpoint_mod
 from . import usig_ui, utils
 from . import viewchange as viewchange_mod
+from ..obs import trace as obs_trace
 from ..utils.backoff import ReconnectBackoff
 from ..utils.metrics import ReplicaMetrics
 from .internal.clientstate import ClientStates
@@ -174,6 +175,17 @@ class Handlers:
         self._peer_vc_bar: Dict[int, int] = {}
         self._ui_lock = asyncio.Lock()
         self.metrics = ReplicaMetrics()
+        # Flight recorder (obs/trace.py): per-request stage spans into a
+        # preallocated ring + per-stage histograms.  None unless the
+        # operator opted in (configer.trace, or the MINBFT_TRACE /
+        # MINBFT_TRACE_DUMP env knobs) — every hook below is then ONE
+        # predicated attribute check (`if tr is not None`), the ISSUE's
+        # disabled-cost contract.
+        self.trace = (
+            obs_trace.FlightRecorder.for_replica(replica_id)
+            if (getattr(configer, "trace", False) or obs_trace.tracing_enabled())
+            else None
+        )
 
         # Verified-check memo: a COMMIT re-validates its embedded PREPARE
         # (which re-validates the embedded REQUEST), so the same
@@ -320,7 +332,24 @@ class Handlers:
             self.client_states.client(req.client_id).stop_prepare_timer()
 
         # --- request pipeline
-        base_validate_request = request_mod.make_request_validator(verify_signature)
+        raw_validate_request = request_mod.make_request_validator(verify_signature)
+
+        if self.trace is not None:
+            _vtr = self.trace
+
+            async def base_validate_request(req: Request) -> None:
+                # Flight-recorder capture point: the REQUEST is about to
+                # be submitted for signature verification (recv→here =
+                # dispatch and bookkeeping; here→verify_done = the
+                # engine round trip including queue wait).
+                _vtr.note(obs_trace.R_VERIFY_ENQUEUE, req.client_id, req.seq)
+                await raw_validate_request(req)
+
+        else:
+            # Tracing off: the raw validator IS the validator — wrapping
+            # unconditionally would put an extra coroutine frame on
+            # every REQUEST's hot path just to test a None.
+            base_validate_request = raw_validate_request
 
         # Object-level validation marker: the interned message objects (see
         # messages/codec.py) arrive repeatedly — a REQUEST via the client
@@ -373,6 +402,29 @@ class Handlers:
         def add_reply(reply: Reply) -> None:
             self.client_states.client(reply.client_id).add_reply(reply.seq, reply)
 
+        # Flight-recorder stage callbacks for the pipeline factories:
+        # plain callables (None when tracing is off) so the factories
+        # stay recorder-agnostic and their hot paths pay one predicated
+        # check each.
+        if self.trace is not None:
+            _tr = self.trace
+
+            def trace_prepare(req: Request) -> None:
+                _tr.note(obs_trace.R_PREPARE, req.client_id, req.seq)
+
+            def trace_quorum(req: Request) -> None:
+                _tr.note(obs_trace.R_COMMIT_QUORUM, req.client_id, req.seq)
+
+            def trace_execute(req: Request) -> None:
+                _tr.note(obs_trace.R_EXECUTE, req.client_id, req.seq)
+
+            def trace_reply_sign(reply: Reply) -> None:
+                _tr.note(obs_trace.R_REPLY_SIGN, reply.client_id, reply.seq)
+
+        else:
+            trace_prepare = trace_quorum = None
+            trace_execute = trace_reply_sign = None
+
         base_execute = request_mod.make_request_executor(
             replica_id,
             retire_seq,
@@ -384,6 +436,8 @@ class Handlers:
             log=self.log,
             metrics=self.metrics,
             sign_message_sync=sign_message,
+            trace_execute=trace_execute,
+            trace_reply_sign=trace_reply_sign,
         )
 
         # Checkpointing (phase 1 + 2 — core/checkpoint.py): every
@@ -510,7 +564,8 @@ class Handlers:
         # --- commit pipeline / quorum (instance kept visible so tests can
         # assert its containers stay bounded)
         self.commitment_collector = commit_mod.CommitmentCollector(
-            f, self.execute_request, on_batch_end=on_batch_end
+            f, self.execute_request, on_batch_end=on_batch_end,
+            trace_quorum=trace_quorum,
         )
 
         async def collect_counted(peer_id: int, prepare: Prepare) -> None:
@@ -527,6 +582,7 @@ class Handlers:
             self.collect_commitment,
             self.handle_generated,
             stop_prepare_timer,
+            trace_prepare=trace_prepare,
         )
 
         async def apply_prepare_counted(prepare: Prepare) -> None:
@@ -1436,7 +1492,12 @@ class Handlers:
             raise api.AuthenticationError("client stream accepts only REQUEST")
         self.metrics.inc("messages_handled")
         self.metrics.inc("requests_received")
+        tr = self.trace
+        if tr is not None:
+            tr.note(obs_trace.R_RECV, msg.client_id, msg.seq)
         await self.validate_message(msg)
+        if tr is not None:
+            tr.note(obs_trace.R_VERIFY_DONE, msg.client_id, msg.seq)
         if msg.is_fast_read:
             # Fast path: answered from committed state, no ordering, no
             # seq capture, no USIG — the caller's finally releases the
@@ -1517,6 +1578,9 @@ class Handlers:
         # Fast reads arrive many-at-once under load: co-batch their REPLY
         # signatures on the sign queue like the ordered executor does.
         await self.sign_message_async(reply)
+        tr = self.trace
+        if tr is not None:
+            tr.note(obs_trace.R_REPLY_SIGN, reply.client_id, reply.seq)
         if not error:
             self.metrics.inc("readonly_served")
         return reply
@@ -1822,7 +1886,13 @@ class ClientStreamHandler(api.MessageStreamHandler):
                 # skipped past it (reference ReplyChannel closes without
                 # sending, reply.go:74-79).
                 return
-            await out_queue.put(marshal(reply))
+            data = marshal(reply)
+            tr = h.trace
+            if tr is not None:
+                # reply_sent = the REPLY is marshaled and queued on the
+                # stream (the last point this replica controls).
+                tr.note(obs_trace.R_REPLY_SENT, reply.client_id, reply.seq)
+            await out_queue.put(data)
 
         # Requests are handled concurrently (replies may take a quorum
         # round-trip each, and a pipelined client sends many requests per

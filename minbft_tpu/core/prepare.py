@@ -69,13 +69,21 @@ def make_prepare_applier(
     collect_commitment,
     handle_generated,
     stop_prepare_timer,
+    trace_prepare=None,
 ) -> Callable[[Prepare], Awaitable[None]]:
-    """Reference makePrepareApplier (core/prepare.go:69-94)."""
+    """Reference makePrepareApplier (core/prepare.go:69-94).
+
+    ``trace_prepare`` is the flight recorder's PREPARE capture point
+    (obs/trace.py): noted when the batch is applied — on every replica,
+    the primary included (its own PREPARE rides the own-message loop) —
+    so the span is uniform cluster-wide.  None when tracing is off."""
 
     async def apply_prepare(prepare: Prepare) -> None:
         for req in prepare.requests:
             prepare_seq(req)
             stop_prepare_timer(req)
+            if trace_prepare is not None:
+                trace_prepare(req)
         await collect_commitment(prepare.replica_id, prepare)
         if prepare.replica_id != replica_id:
             # A backup commits to the accepted proposal

@@ -71,6 +71,11 @@ class _Replica(api.Replica):
         """Protocol counters + latency (minbft_tpu.utils.metrics)."""
         return self.handlers.metrics
 
+    @property
+    def trace(self):
+        """Flight recorder (minbft_tpu.obs.trace), or None when off."""
+        return self.handlers.trace
+
     def peer_message_stream_handler(self) -> api.MessageStreamHandler:
         return message_handling.PeerStreamHandler(self.handlers)
 
@@ -104,6 +109,12 @@ class _Replica(api.Replica):
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
+        if self.handlers.trace is not None:
+            # JSON trace dump on shutdown (no-op unless MINBFT_TRACE_DUMP
+            # is set): one file per replica, bench.py ingests them.
+            from ..obs import trace as obs_trace
+
+            obs_trace.dump_recorder(self.handlers.trace)
 
 
 def new_replica(

@@ -94,6 +94,8 @@ def make_request_executor(
     log=None,
     metrics=None,
     sign_message_sync=None,
+    trace_execute=None,
+    trace_reply_sign=None,
 ) -> Callable[[Request], Awaitable[None]]:
     """Execute a committed REQUEST exactly once (reference
     makeRequestExecutor, core/request.go:211-231): retire the seq (dedup),
@@ -133,7 +135,11 @@ def make_request_executor(
     — callers counting executions (metrics, the checkpoint period, which
     must stay a deterministic global sequence number across replicas) must
     only count on True, or replicas that executed pre-transition would
-    count a request twice while others count once."""
+    count a request twice while others count once.
+
+    ``trace_execute`` / ``trace_reply_sign`` are the flight recorder's
+    stage callbacks (obs/trace.py) — None when tracing is off, so the
+    hot path pays one predicated check each."""
     # Strong refs for the in-flight sign-and-buffer tasks (discarded by
     # their done-callback) — a GC'd task would silently drop a REPLY.
     sign_tasks: set = set()
@@ -183,6 +189,8 @@ def make_request_executor(
                     metrics.inc("readonly_query_errors")
         else:
             result = await consumer.deliver(request.operation)
+        if trace_execute is not None:
+            trace_execute(request)
         reply = Reply(
             replica_id=replica_id,
             client_id=request.client_id,
@@ -199,6 +207,8 @@ def make_request_executor(
             try:
                 await sign_message_async(reply)
                 signed = True
+                if trace_reply_sign is not None:
+                    trace_reply_sign(reply)
             except Exception:
                 if log is not None:
                     log.exception(
@@ -211,6 +221,8 @@ def make_request_executor(
                     try:
                         sign_message_sync(reply)
                         signed = True
+                        if trace_reply_sign is not None:
+                            trace_reply_sign(reply)
                     except Exception:
                         # Both signers down: this reply is lost on this
                         # replica (the other replicas' quorum carries the

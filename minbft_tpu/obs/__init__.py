@@ -1,0 +1,50 @@
+"""Observability subsystem: the protocol flight recorder.
+
+Three layers (ISSUE 4; SURVEY.md §5 notes the reference's only
+instrumentation is leveled logging):
+
+- :mod:`~minbft_tpu.obs.trace` — per-request stage spans into
+  preallocated ring buffers, with per-stage log2 histograms and the
+  JSON trace dump (``MINBFT_TRACE_DUMP=path``) bench.py ingests;
+- :mod:`~minbft_tpu.obs.hist` — fixed-bucket mergeable latency
+  histograms (the streaming counterpart of the exact-but-unmergeable
+  :class:`~minbft_tpu.utils.metrics.LatencyReservoir`);
+- :mod:`~minbft_tpu.obs.prom` — Prometheus text exposition served from
+  an stdlib HTTP endpoint (``peer run --metrics-port`` / the
+  ``peer metrics`` scrape subcommand).
+
+Nothing in this package is reachable from jitted code (enforced by the
+``tools/analyze`` trace-purity pass), and with tracing disabled the
+protocol pays one predicated attribute check per hook.
+"""
+
+from .hist import Log2Histogram
+from .prom import MetricsServer, collect_replica, render_families, scrape
+from .trace import (
+    CLIENT_STAGES,
+    REPLICA_STAGES,
+    FlightRecorder,
+    MTStageRing,
+    StageRing,
+    dump_recorder,
+    load_dumps,
+    stage_table,
+    tracing_enabled,
+)
+
+__all__ = [
+    "CLIENT_STAGES",
+    "REPLICA_STAGES",
+    "FlightRecorder",
+    "Log2Histogram",
+    "MTStageRing",
+    "MetricsServer",
+    "StageRing",
+    "collect_replica",
+    "dump_recorder",
+    "load_dumps",
+    "render_families",
+    "scrape",
+    "stage_table",
+    "tracing_enabled",
+]

@@ -1,0 +1,299 @@
+"""Prometheus text exposition (format 0.0.4) over the stdlib — no
+aiohttp, no client library: the endpoint is a daemon-thread
+``http.server`` serving a render callback, and the render walks plain
+counters/histograms.
+
+Consistency model: the scrape thread reads ints the event loop (and the
+engine's worker threads) are mutating.  Every exposed value is either a
+GIL-atomic int/float store or a monotonic counter, so a scrape sees a
+slightly stale but never torn value — the standard Prometheus contract
+(scrapes are samples, not transactions).  Nothing here takes the event
+loop's locks, so a slow scraper can never stall the protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from .hist import Log2Histogram
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# family = (name, type, help, [(labels, value)]) for counter/gauge;
+# histogram families carry (labels, Log2Histogram) samples instead.
+Family = Tuple[str, str, str, List[Tuple[Dict[str, str], object]]]
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def render_families(families: Iterable[Family]) -> str:
+    """Render metric families to Prometheus text format."""
+    lines: List[str] = []
+    for name, mtype, help_text, samples in families:
+        if not samples:
+            continue
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        if mtype == "histogram":
+            for labels, hist in samples:
+                assert isinstance(hist, Log2Histogram)
+                bounds = hist.bucket_upper_bounds_s()
+                # ONE snapshot of the bucket array, with count/+Inf
+                # derived from it: reading live buckets and hist.count
+                # separately could interleave with an observe() between
+                # its two increments and emit a finite bucket above
+                # +Inf — invalid per the histogram contract (le-series
+                # must be monotone up to +Inf).
+                buckets = list(hist.buckets)
+                total = sum(buckets)
+                cum = 0
+                last_nonzero = -1
+                for i, c in enumerate(buckets):
+                    if c:
+                        last_nonzero = i
+                for i in range(last_nonzero + 1):
+                    c = buckets[i]
+                    cum += c
+                    if c == 0 and i != last_nonzero:
+                        continue  # empty buckets add no information
+                    lb = dict(labels)
+                    lb["le"] = repr(bounds[i])
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(lb)} {cum}"
+                    )
+                lb = dict(labels)
+                lb["le"] = "+Inf"
+                lines.append(f"{name}_bucket{_fmt_labels(lb)} {total}")
+                lines.append(
+                    f"{name}_sum{_fmt_labels(labels)} {_fmt_value(hist.total_s)}"
+                )
+                lines.append(f"{name}_count{_fmt_labels(labels)} {total}")
+        else:
+            for labels, value in samples:
+                lines.append(
+                    f"{name}{_fmt_labels(labels)} {_fmt_value(value)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def collect_replica(
+    metrics=None,
+    recorder=None,
+    engine=None,
+    replica_id: Optional[int] = None,
+) -> List[Family]:
+    """Build the metric families for one replica process.
+
+    ``metrics`` is a :class:`minbft_tpu.utils.metrics.ReplicaMetrics`,
+    ``recorder`` a :class:`minbft_tpu.obs.trace.FlightRecorder` (or
+    None when tracing is off — the stage families simply vanish), and
+    ``engine`` a :class:`minbft_tpu.parallel.BatchVerifier` (or None
+    for ``--no-batch`` replicas).
+    """
+    base = {} if replica_id is None else {"replica": str(replica_id)}
+    fams: List[Family] = []
+    if metrics is not None:
+        # dict(...) snapshots the counter map once: the loop may insert
+        # new counters mid-walk.
+        for cname, v in sorted(dict(metrics.counters).items()):
+            fams.append(
+                (
+                    f"minbft_{cname}_total",
+                    "counter",
+                    f"protocol counter {cname}",
+                    [(base, v)],
+                )
+            )
+        fams.append(
+            (
+                "minbft_uptime_seconds",
+                "gauge",
+                "seconds since the replica's metrics started",
+                [(base, round(metrics.uptime_s, 3))],
+            )
+        )
+        exec_hist = getattr(metrics, "execute_hist", None)
+        if exec_hist is not None and exec_hist.count:
+            fams.append(
+                (
+                    "minbft_execute_latency_seconds",
+                    "histogram",
+                    "request execution latency (deliver to the consumer)",
+                    [(base, exec_hist)],
+                )
+            )
+    if recorder is not None:
+        samples = []
+        for name, h in recorder.stage_hists().items():
+            lb = dict(base)
+            lb["stage"] = name
+            samples.append((lb, h))
+        fams.append(
+            (
+                "minbft_stage_latency_seconds",
+                "histogram",
+                "flight-recorder span: time from the previous capture "
+                "point to this stage",
+                samples,
+            )
+        )
+    if engine is not None:
+        fams.extend(_collect_engine(engine, base))
+    return fams
+
+
+def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
+    fams: List[Family] = []
+    for side, stats_map, depths in (
+        ("verify", engine.stats, engine.queue_depths()),
+        ("sign", engine.sign_stats, engine.sign_queue_depths()),
+    ):
+        counters: Dict[str, List] = {
+            "items": [],
+            "batches": [],
+            "padded_lanes": [],
+            "dispatch_timeouts": [],
+        }
+        seconds: Dict[str, List] = {"device": [], "host_prep": []}
+        flushes: List = []
+        occupancy: List = []
+        depth_samples: List = []
+        for qname, st in sorted(stats_map.items()):
+            lb = dict(base)
+            lb["queue"] = qname
+            for k in counters:
+                counters[k].append((lb, getattr(st, k, 0)))
+            seconds["device"].append((lb, st.device_time_s))
+            seconds["host_prep"].append((lb, st.host_prep_time_s))
+            # dict(...) snapshots before iterating: the event loop
+            # inserts new reasons/buckets while this thread walks.
+            for reason, cnt in sorted(
+                dict(getattr(st, "flush_reasons", {})).items()
+            ):
+                lbr = dict(lb)
+                lbr["reason"] = reason
+                flushes.append((lbr, cnt))
+            for log2_size, cnt in sorted(
+                dict(getattr(st, "occupancy", {})).items()
+            ):
+                lbo = dict(lb)
+                # upper bound of the log2 occupancy bucket, in items
+                lbo["le_items"] = str(1 << int(log2_size))
+                occupancy.append((lbo, cnt))
+        for qname, depth in sorted(depths.items()):
+            lb = dict(base)
+            lb["queue"] = qname
+            depth_samples.append((lb, depth))
+        p = f"minbft_{side}_queue"
+        fams.append((f"{p}_items_total", "counter",
+                     f"{side} items dispatched", counters["items"]))
+        fams.append((f"{p}_batches_total", "counter",
+                     f"{side} batches dispatched", counters["batches"]))
+        fams.append((f"{p}_padded_lanes_total", "counter",
+                     "bucket-padding lanes wasted", counters["padded_lanes"]))
+        fams.append((f"{p}_dispatch_timeouts_total", "counter",
+                     "hung dispatches rescued on host",
+                     counters["dispatch_timeouts"]))
+        fams.append((f"{p}_device_seconds_total", "counter",
+                     "seconds awaiting dispatches", seconds["device"]))
+        fams.append((f"{p}_host_prep_seconds_total", "counter",
+                     "host share of dispatch time (prep/pack/finish)",
+                     seconds["host_prep"]))
+        fams.append((f"{p}_flushes_total", "counter",
+                     "queue flushes by reason (full/idle/timer/completion)",
+                     flushes))
+        fams.append((f"{p}_batch_occupancy_total", "counter",
+                     "batches by log2 occupancy bucket (pre-padding)",
+                     occupancy))
+        fams.append((f"{p}_depth", "gauge",
+                     "items pending in the queue right now", depth_samples))
+    return fams
+
+
+class MetricsServer:
+    """``/metrics`` on a daemon thread (stdlib ThreadingHTTPServer).
+
+    ``render`` is called per scrape on a SERVER thread — it must only
+    read (see the module docstring's consistency model).  ``start``
+    returns the bound port (pass 0 to pick a free one).  Binds loopback
+    by default: the endpoint is unauthenticated, so exposing it beyond
+    the host is an explicit operator decision (``--metrics-host``)."""
+
+    def __init__(self, render: Callable[[], str],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._render = render
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        render = self._render
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API name
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render().encode()
+                except Exception as e:  # noqa: BLE001 - a scrape bug
+                    # must report, not kill the handler thread silently
+                    self.send_error(500, str(e)[:200])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not log events
+                pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="minbft-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def scrape(url: str, timeout: float = 5.0) -> str:
+    """One-shot metrics fetch (the ``peer metrics`` subcommand).
+    ``url`` may be a bare ``host:port`` — ``/metrics`` is implied."""
+    from urllib.request import urlopen
+
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()

@@ -85,6 +85,14 @@ class VerifyStats:
     host_prep_time_s: float = 0.0
     memo_hits: int = 0
     dispatch_timeouts: int = 0  # hung device dispatches rescued on host
+    # Flight-recorder gauges (event-loop-side updates only): why each
+    # batch shipped ("full" / "idle" / "timer" / "completion" — the
+    # ship-when-idle policy made observable), and pre-padding batch
+    # occupancy bucketed by log2 size (key = (len(batch)-1).bit_length(),
+    # so bucket k holds batches of 2^(k-1) < size <= 2^k items — prom.py
+    # labels it with the 2^k upper edge).  Both sum to ``batches``.
+    flush_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
+    occupancy: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     @property
     def mean_batch(self) -> float:
@@ -113,6 +121,10 @@ class SignStats:
     host_prep_time_s: float = 0.0
     dispatch_timeouts: int = 0
     host_fallback_items: int = 0
+    # See VerifyStats: flush-reason and log2 batch-occupancy gauges,
+    # loop-side updates only.
+    flush_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
+    occupancy: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     @property
     def mean_batch(self) -> float:
@@ -192,7 +204,15 @@ class _DispatchQueue:
 
     def _device_enabled(self) -> bool:
         """False routes every batch straight to the fallback without
-        arming the timeout machinery."""
+        arming the timeout machinery.  May block (first call can
+        initialize the jax backend) — only invoked off-loop."""
+        return True
+
+    def _device_enabled_fast(self):
+        """Loop-safe view of the device-enabled state: the resolved
+        bool, or None when resolution would block (the sign queues'
+        backend probe initializes jax on first touch — that must happen
+        on a worker thread, never on the event loop)."""
         return True
 
     def _resolve(self, batch, results, fell_back: bool) -> None:
@@ -203,7 +223,7 @@ class _DispatchQueue:
         """Resolve a failed batch's futures with the failure."""
         raise NotImplementedError
 
-    async def _run(self, batch) -> None:
+    async def _run(self, batch, reason: str) -> None:
         """One dispatch: liveness-netted execution, shared accounting,
         then the subclass's resolution policy.  The finally re-flush is
         what implements flush-on-completion (accumulated items ship the
@@ -221,13 +241,25 @@ class _DispatchQueue:
             # event loop — no read-modify-write spans a suspension.
             self.inflight -= 1  # noqa: LD001
             if self.pending:
-                self._flush_now()
+                self._flush_now("completion")
         dt = time.monotonic() - t0
         st = self.stats
         st.items += len(batch)
         st.batches += 1
         st.max_batch_seen = max(st.max_batch_seen, len(batch))
         st.device_time_s += dt
+        # Flush-reason and occupancy gauges, counted HERE with batches —
+        # not at flush time — so both always sum to ``batches`` (a batch
+        # whose dispatch raises is counted in neither, keeping the
+        # exported invariant true on error paths too).
+        st.flush_reasons[reason] = st.flush_reasons.get(reason, 0) + 1
+        # Pre-padding occupancy, log2-bucketed (loop-side — _run's
+        # accounting block runs on the event loop like the rest of st).
+        # (n-1).bit_length() puts bucket k at 2^(k-1) < size <= 2^k — the
+        # documented upper-edge convention, so a full power-of-two batch
+        # (the common case under load) lands in ITS bucket, not one up.
+        occ = (len(batch) - 1).bit_length()
+        st.occupancy[occ] = st.occupancy.get(occ, 0) + 1
         self._resolve(batch, results, fell_back)
 
     # -- flush scheduling ---------------------------------------------------
@@ -235,21 +267,21 @@ class _DispatchQueue:
     def _schedule_flush(self, fut: asyncio.Future) -> asyncio.Future:
         loop = asyncio.get_running_loop()
         if len(self.pending) >= self.engine.max_batch:
-            self._flush_now()
+            self._flush_now("full")
         elif self.inflight == 0 and self._flush_handle is None:
             # Device idle: flush on the next loop turn (after every
             # already-runnable coroutine has had the chance to co-submit),
             # optionally stretched by max_delay to coalesce more.
             if self.engine.max_delay > 0:
                 self._flush_handle = loop.call_later(
-                    self.engine.max_delay, self._flush_now
+                    self.engine.max_delay, self._flush_now, "timer"
                 )
             else:
-                self._flush_handle = loop.call_soon(self._flush_now)
+                self._flush_handle = loop.call_soon(self._flush_now, "idle")
         # else: a dispatch is in flight — accumulate; its completion flushes.
         return fut
 
-    def _flush_now(self) -> None:
+    def _flush_now(self, reason: str = "direct") -> None:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
@@ -258,7 +290,9 @@ class _DispatchQueue:
             batch = self.pending[:max_batch]
             del self.pending[:max_batch]
             self.inflight += 1
-            asyncio.get_running_loop().create_task(self._run(batch))
+            # The reason rides with the batch and is counted in _run's
+            # success accounting alongside ``batches``.
+            asyncio.get_running_loop().create_task(self._run(batch, reason))
 
     # -- dispatch with the liveness net -------------------------------------
 
@@ -279,7 +313,14 @@ class _DispatchQueue:
         max_inflight dispatches across the awaits)."""
         fallback = self._fallback()
         timeout = self.engine.dispatch_timeout
-        if fallback is not None and not self._device_enabled():
+        enabled = self._device_enabled_fast()
+        if enabled is None:
+            # Unresolved (first sign dispatch): the backend probe
+            # initializes jax — run it on a worker thread so the event
+            # loop (protocol timers, every other coroutine) never
+            # stalls behind a backend init.
+            enabled = await asyncio.to_thread(self._device_enabled)
+        if fallback is not None and not enabled:
             # No healthy device for this queue (e.g. the sign queues on a
             # CPU backend): the host path IS the path — no timeout arming,
             # no write-off bookkeeping, fallback recorded in stats.  This
@@ -484,6 +525,11 @@ class _SignQueue(_DispatchQueue):
     def _device_enabled(self) -> bool:
         return self.engine._sign_device_enabled()
 
+    def _device_enabled_fast(self):
+        # None until the first resolution (reading the backend can
+        # block) — see _DispatchQueue._device_enabled_fast.
+        return self.engine._sign_on_device
+
     def submit(self, item) -> asyncio.Future:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -605,6 +651,60 @@ class BatchVerifier:
         self._queues: Dict[str, _SchemeQueue] = {}
         self._sign_queues: Dict[str, _SignQueue] = {}
         self._staging = _StagingPool(cap=max_inflight)
+        # Flight-recorder hookup (obs/): dispatcher-side span events —
+        # (queue, padded lanes, host-prep ns) per dispatch — pushed by
+        # the WORKER threads into a multi-producer ring.  None until an
+        # operator enables it; the disabled cost is one attribute check
+        # per dispatch (not per item).  Queue-name ids are interned under
+        # _stats_lock (the same cross-thread discipline as the stats).
+        self._obs_ring = None
+        self._obs_queue_ids: Dict[str, int] = {}
+
+    # -- flight-recorder surface -------------------------------------------
+
+    def enable_obs_ring(self, capacity: int = 4096) -> None:
+        """Start recording per-dispatch span events (see _note_prep)."""
+        from ..obs.trace import MTStageRing
+
+        if self._obs_ring is None:
+            self._obs_ring = MTStageRing(capacity)
+
+    def _obs_queue_id(self, name: str) -> int:
+        qid = self._obs_queue_ids.get(name)  # GIL-atomic fast path
+        if qid is None:
+            with self._stats_lock:
+                qid = self._obs_queue_ids.get(name)
+                if qid is None:
+                    qid = len(self._obs_queue_ids)
+                    self._obs_queue_ids[name] = qid
+        return qid
+
+    def drain_obs_events(self) -> list:
+        """Decoded dispatcher span events, oldest→newest:
+        (queue_name, padded_lanes, host_prep_ns, t_monotonic_ns)."""
+        ring = self._obs_ring
+        if ring is None:
+            return []
+        # dict() is a C-level copy (GIL-atomic): worker threads may be
+        # interning new names while we decode.
+        names = {v: k for k, v in dict(self._obs_queue_ids).items()}
+        return [
+            (names.get(qid, f"queue{qid}"), pad, prep_ns, t_ns)
+            for qid, pad, prep_ns, t_ns in ring.snapshot()
+        ]
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Items pending per verify queue right now (scrape gauge).
+        dict() snapshots the live queue map first — the metrics thread
+        iterates while the loop lazily inserts new queues, and a bare
+        .items() walk could see the dict resize mid-iteration; len() of
+        a loop-owned list is GIL-atomic, never torn."""
+        return {name: len(q.pending) for name, q in dict(self._queues).items()}
+
+    def sign_queue_depths(self) -> Dict[str, int]:
+        return {
+            name: len(q.pending) for name, q in dict(self._sign_queues).items()
+        }
 
     def _sharded(self, name: str, builder):
         # Dispatchers run on worker threads (max_inflight > 1): lock the
@@ -673,11 +773,13 @@ class BatchVerifier:
 
     @property
     def stats(self) -> Dict[str, VerifyStats]:
-        return {name: q.stats for name, q in self._queues.items()}
+        # dict() snapshot: scrape threads iterate while the loop inserts
+        # new queues (see queue_depths).
+        return {name: q.stats for name, q in dict(self._queues).items()}
 
     @property
     def sign_stats(self) -> Dict[str, SignStats]:
-        return {name: q.stats for name, q in self._sign_queues.items()}
+        return {name: q.stats for name, q in dict(self._sign_queues).items()}
 
     # -- public API ---------------------------------------------------------
 
@@ -778,6 +880,16 @@ class BatchVerifier:
             st = self._queues[name].stats
             st.padded_lanes += pad
             st.host_prep_time_s += prep_s
+        ring = self._obs_ring
+        if ring is not None:
+            # Dispatcher span event from the worker thread: the ring's
+            # own lock serializes concurrent max_inflight producers.
+            ring.push(
+                self._obs_queue_id(name),
+                pad,
+                int(prep_s * 1e9),
+                time.monotonic_ns(),
+            )
 
     def _note_sign_prep(self, name: str, pad: int, prep_s: float) -> None:
         """Sign-queue sibling of :meth:`_note_prep` (worker thread):
@@ -786,6 +898,14 @@ class BatchVerifier:
             st = self._sign_queues[name].stats
             st.padded_lanes += pad
             st.host_prep_time_s += prep_s
+        ring = self._obs_ring
+        if ring is not None:
+            ring.push(
+                self._obs_queue_id("sign_" + name),
+                pad,
+                int(prep_s * 1e9),
+                time.monotonic_ns(),
+            )
 
     def _dispatch_ecdsa(self, items) -> np.ndarray:
         import jax.numpy as jnp

@@ -11,6 +11,10 @@ flags layer over ``PEER_*`` environment variables (root.go:73-82).
     python -m minbft_tpu.sample.peer --keys keys.yaml --config consensus.yaml run 0
     python -m minbft_tpu.sample.peer --keys keys.yaml --config consensus.yaml request "op"
     python -m minbft_tpu.sample.peer selftest   # in-process n=4 smoke test
+    python -m minbft_tpu.sample.peer metrics 127.0.0.1:9464   # scrape
+    # `run --metrics-port N` serves Prometheus text (stdlib HTTP, no
+    # aiohttp); MINBFT_TRACE_DUMP=path turns the flight recorder on and
+    # dumps per-request stage spans at shutdown (README §Observability).
 
 The replica's COMMIT-phase verification runs through the TPU batching
 engine (``--batch``); ``--no-batch`` falls back to serial host crypto.
@@ -38,7 +42,8 @@ def _env(name: str, fallback, choices=None):
 # flags > PEER_* env vars > options file > built-in defaults.
 _PEER_OPTION_SCHEMA = {
     None: {"keys", "config", "log_level", "log_file", "auth", "transport"},
-    "run": {"listen", "batch", "metrics_interval"},
+    "run": {"listen", "batch", "metrics_interval", "metrics_port",
+            "metrics_host"},
     "request": {"client_id", "timeout"},
 }
 
@@ -190,6 +195,31 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         default=_opt("metrics_interval", 0.0, section="run"),
         help="log the protocol counters every N seconds (0 = off)",
     )
+    r.add_argument(
+        "--metrics-port",
+        type=int,
+        default=_opt("metrics_port", -1, section="run"),
+        help="serve Prometheus text metrics on this port (stdlib HTTP, "
+        "daemon thread; 0 = pick a free port, printed to stderr; "
+        "default: off).  Scrape with `peer metrics host:port`.",
+    )
+    r.add_argument(
+        "--metrics-host",
+        default=_opt("metrics_host", "127.0.0.1", section="run"),
+        help="bind address for --metrics-port (default loopback — the "
+        "endpoint is unauthenticated; widen deliberately)",
+    )
+
+    m = sub.add_parser(
+        "metrics",
+        help="one-shot Prometheus scrape of a replica's --metrics-port "
+        "endpoint (prints the exposition text)",
+    )
+    m.add_argument(
+        "addr",
+        help="host:port (or full URL) of the replica's metrics endpoint",
+    )
+    m.add_argument("--timeout", type=float, default=5.0)
 
     q = sub.add_parser("request", help="submit request(s) as a client")
     q.add_argument("ops", nargs="*", help="operations (default: stdin lines)")
@@ -337,6 +367,40 @@ async def _run_replica(args) -> int:
     print(f"replica {args.id} serving on {bound}", file=sys.stderr)
     await replica.start()
 
+    from ...obs import trace as obs_trace
+
+    # Engine dispatcher spans are exported by the MINBFT_TRACE_DUMP
+    # shutdown dump, so recording is gated on exactly that knob —
+    # independent of --metrics-port (a dump-only run must not lose
+    # them), and never enabled without an export path (events must not
+    # be recorded only to be discarded).
+    if engine is not None and os.environ.get(obs_trace.TRACE_DUMP_ENV):
+        engine.enable_obs_ring()
+
+    metrics_server = None
+    if args.metrics_port >= 0:
+        from ...obs import prom as obs_prom
+
+        def render() -> str:
+            return obs_prom.render_families(
+                obs_prom.collect_replica(
+                    metrics=replica.metrics,
+                    recorder=replica.handlers.trace,
+                    engine=engine,
+                    replica_id=args.id,
+                )
+            )
+
+        metrics_server = obs_prom.MetricsServer(
+            render, host=args.metrics_host, port=args.metrics_port
+        )
+        mport = metrics_server.start()
+        print(
+            f"replica {args.id} metrics on "
+            f"http://{args.metrics_host}:{mport}/metrics",
+            file=sys.stderr,
+        )
+
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -361,7 +425,25 @@ async def _run_replica(args) -> int:
     if metrics_task is not None:
         metrics_task.cancel()
     print(f"replica {args.id} shutting down", file=sys.stderr)
-    await replica.stop()
+    if metrics_server is not None:
+        metrics_server.stop()
+    await replica.stop()  # writes the replica's MINBFT_TRACE_DUMP file
+    if engine is not None:
+        # Engine dispatcher spans ride the shutdown dump alongside the
+        # replica's stage dump (no-op unless the ring was enabled and
+        # MINBFT_TRACE_DUMP is set — recorded events must land
+        # somewhere, not silently vanish).
+        events = engine.drain_obs_events()
+        base = os.environ.get("MINBFT_TRACE_DUMP")
+        if events and base:
+            import json as _json
+
+            with open(f"{base}.engine{args.id}.json", "w") as fh:
+                _json.dump(
+                    {"kind": "engine", "id": args.id,
+                     "events": [list(e) for e in events]},
+                    fh,
+                )
     await server.stop()
     await conn.close()
     return 0
@@ -704,11 +786,27 @@ def _run_testnet_scaffold(args) -> int:
     return 0
 
 
+def _run_metrics_scrape(args) -> int:
+    """``peer metrics host:port`` — fetch and print one Prometheus
+    exposition from a running replica (synchronous: one GET, no event
+    loop)."""
+    from ...obs.prom import scrape
+
+    try:
+        sys.stdout.write(scrape(args.addr, timeout=args.timeout))
+    except OSError as e:
+        print(f"peer: metrics scrape of {args.addr} failed: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     path, explicit = peek_options_path(argv)
     args = build_parser(load_peer_options(path, explicit)).parse_args(argv)
     if args.command == "run":
         return asyncio.run(_run_replica(args))
+    if args.command == "metrics":
+        return _run_metrics_scrape(args)
     if args.command == "request":
         return asyncio.run(_run_request(args))
     if args.command == "bench":

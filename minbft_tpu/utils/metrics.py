@@ -29,6 +29,12 @@ class LatencyReservoir:
         import random
 
         self._samples: list = []
+        # Sorted view of _samples, built lazily on the first percentile
+        # call and reused until the next observe invalidates it: metric
+        # snapshots ask for several percentiles back-to-back, and
+        # re-sorting the full 2048-sample reservoir for each one made
+        # every snapshot O(k · n log n) for no reason.
+        self._sorted: "list | None" = None
         # Fixed seed: percentiles are statistics, but reproducible runs
         # help debugging.
         self._rng = random.Random(0x9E3779B97F4A7C15)
@@ -36,6 +42,7 @@ class LatencyReservoir:
     def observe(self, seconds: float) -> None:
         self.count += 1
         self.total_s += seconds
+        self._sorted = None  # invalidate the cached sorted view
         if len(self._samples) < self.capacity:
             self._samples.append(seconds)
         else:
@@ -54,7 +61,9 @@ class LatencyReservoir:
     def percentile(self, q: float) -> float:
         if not self._samples:
             return 0.0
-        s = sorted(self._samples)
+        s = self._sorted
+        if s is None:
+            s = self._sorted = sorted(self._samples)
         # nearest-rank: smallest value with at least q% of samples <= it.
         # Round away binary-float fuzz first (q=55, n=100 would otherwise
         # compute ceil(55.000000000000014) = 56).
@@ -75,8 +84,15 @@ class ReplicaMetrics:
     """
 
     def __init__(self):
+        from ..obs.hist import Log2Histogram
+
         self.counters: Dict[str, int] = {}
         self.execute_latency = LatencyReservoir()
+        # Streaming log2 histogram next to the reservoir (obs/hist.py):
+        # mergeable across replicas and scrape-safe, it feeds the
+        # Prometheus exposition; the reservoir keeps exact samples for
+        # the snapshot()/bench percentiles.
+        self.execute_hist = Log2Histogram()
         self._started = time.monotonic()
 
     def inc(self, name: str, by: int = 1) -> None:
@@ -84,6 +100,7 @@ class ReplicaMetrics:
 
     def observe_execute(self, seconds: float) -> None:
         self.execute_latency.observe(seconds)
+        self.execute_hist.observe(seconds)
 
     @property
     def uptime_s(self) -> float:

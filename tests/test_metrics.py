@@ -21,6 +21,35 @@ def test_latency_reservoir_stats():
     assert r.percentile(99) == 0.05
 
 
+def test_reservoir_percentile_caches_sorted_view():
+    """percentile() used to re-sort the full reservoir on EVERY call
+    (snapshot() asks for several percentiles back-to-back); the sorted
+    view is now cached and invalidated by observe()."""
+    r = LatencyReservoir(capacity=64)
+    for v in [0.03, 0.01, 0.02]:
+        r.observe(v)
+    assert r._sorted is None  # built lazily, invalidated by observe
+    assert r.percentile(50) == 0.02
+    cached = r._sorted
+    assert cached == [0.01, 0.02, 0.03]
+    # a second percentile reuses the SAME list object — no re-sort
+    assert r.percentile(99) == 0.03
+    assert r._sorted is cached
+    # observe invalidates; the next percentile reflects the new sample
+    r.observe(0.005)
+    assert r._sorted is None
+    assert r.percentile(0) == 0.005
+    # overflow path (reservoir replacement) invalidates too
+    full = LatencyReservoir(capacity=4)
+    for v in [0.1, 0.2, 0.3, 0.4]:
+        full.observe(v)
+    assert full.percentile(99) == 0.4
+    for _ in range(64):
+        full.observe(0.9)
+    assert full._sorted is None
+    assert full.percentile(99) == 0.9
+
+
 def test_aggregate_sums_counters_and_averages_latency():
     a, b = ReplicaMetrics(), ReplicaMetrics()
     a.inc("requests_executed", 3)
@@ -30,6 +59,18 @@ def test_aggregate_sums_counters_and_averages_latency():
     agg = aggregate([a.snapshot(), b.snapshot()])
     assert agg["requests_executed"] == 8
     assert abs(agg["execute_latency_mean_ms"] - 20.0) < 0.5
+
+
+def test_execute_hist_mirrors_the_reservoir():
+    """The mergeable log2 histogram (obs/hist.py, feeds the Prometheus
+    exposition) observes every execution the reservoir does."""
+    m = ReplicaMetrics()
+    m.observe_execute(0.010)
+    m.observe_execute(0.030)
+    assert m.execute_hist.count == 2 == m.execute_latency.count
+    assert abs(m.execute_hist.total_s - m.execute_latency.total_s) < 1e-12
+    # log2 resolution: p99 within a factor of 2 above the exact value
+    assert 0.03 <= m.execute_hist.percentile(99) <= 0.06
 
 
 def test_cluster_populates_counters():

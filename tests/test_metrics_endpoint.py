@@ -1,0 +1,255 @@
+"""Prometheus exposition tests: text-format rendering, the stdlib
+``/metrics`` endpoint scraped off a live in-process cluster, the
+``peer metrics`` one-shot subcommand, and the bench-keys regression pin
+(tracing disabled must be key-identical to tracing absent)."""
+
+import asyncio
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from minbft_tpu.obs.hist import Log2Histogram
+from minbft_tpu.obs.prom import (
+    CONTENT_TYPE,
+    MetricsServer,
+    collect_replica,
+    render_families,
+    scrape,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from conftest import make_cluster  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def test_render_counters_and_gauges():
+    text = render_families(
+        [
+            ("m_total", "counter", "help text", [({"replica": "0"}, 3)]),
+            ("g", "gauge", "a gauge", [({}, 1.5)]),
+            ("empty", "counter", "skipped entirely", []),
+        ]
+    )
+    assert "# HELP m_total help text" in text
+    assert "# TYPE m_total counter" in text
+    assert 'm_total{replica="0"} 3' in text
+    assert "g 1.5" in text
+    assert "empty" not in text
+
+
+def test_render_histogram_is_cumulative_with_inf():
+    h = Log2Histogram()
+    for v in (1e-6, 1e-6, 3e-6, 1e-3):
+        h.observe(v)
+    text = render_families(
+        [("lat_seconds", "histogram", "latency", [({"stage": "s"}, h)])]
+    )
+    lines = [ln for ln in text.splitlines() if ln.startswith("lat_seconds")]
+    buckets = [ln for ln in lines if "_bucket" in ln]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == 4
+    assert 'le="+Inf"' in buckets[-1]
+    assert 'lat_seconds_count{stage="s"} 4' in text
+    assert any(ln.startswith("lat_seconds_sum") for ln in lines)
+
+
+def test_collect_replica_families_from_live_objects():
+    from minbft_tpu.obs.trace import FlightRecorder
+    from minbft_tpu.utils.metrics import ReplicaMetrics
+
+    m = ReplicaMetrics()
+    m.inc("requests_executed", 2)
+    m.observe_execute(0.01)
+    rec = FlightRecorder.for_replica(1)
+    rec.note(0, 0, 1)
+    rec.note(1, 0, 1)
+    text = render_families(collect_replica(metrics=m, recorder=rec, replica_id=1))
+    assert 'minbft_requests_executed_total{replica="1"} 2' in text
+    assert "minbft_uptime_seconds" in text
+    assert "minbft_execute_latency_seconds_count" in text
+    assert 'minbft_stage_latency_seconds_count{replica="1",stage="verify_enqueue"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# live endpoint
+
+
+def test_metrics_endpoint_scrapes_a_committing_cluster():
+    """Acceptance smoke: a 4-replica in-process cluster commits requests
+    with the flight recorder on; the stdlib endpoint serves Prometheus
+    text that carries the protocol counters, the stage histograms, AND
+    the engine queue gauges — scraped over real HTTP while the loop is
+    live, by raw urllib and by the `peer metrics` subcommand."""
+
+    async def run():
+        from minbft_tpu.client import new_client
+        from minbft_tpu.parallel import BatchVerifier
+        from minbft_tpu.sample.config import SimpleConfiger
+        from minbft_tpu.sample.conn.inprocess import InProcessClientConnector
+
+        cfg = SimpleConfiger(
+            n=4, f=1, timeout_request=60.0, timeout_prepare=30.0
+        )
+        cfg.trace = True  # flight recorder on for every replica
+        engine = BatchVerifier(max_batch=8, buckets=(8,))
+        # batch_signatures=False: message signatures stay on the host
+        # queue, so the only device kernel this test compiles is the
+        # cheap HMAC USIG one (the CPU-backend ECDSA verify kernel takes
+        # minutes to build — not a price a smoke test pays).
+        replicas, c_auths, stubs, _ledgers = await make_cluster(
+            4, 1, cfg=cfg, engines=[engine] * 4, batch_signatures=False
+        )
+        client = new_client(
+            0, 4, 1, c_auths[0], InProcessClientConnector(stubs), seq_start=0
+        )
+        await client.start()
+        try:
+            for i in range(3):
+                await asyncio.wait_for(client.request(b"scrape-%d" % i), 30)
+
+            server = MetricsServer(
+                lambda: render_families(
+                    collect_replica(
+                        metrics=replicas[0].metrics,
+                        recorder=replicas[0].trace,
+                        engine=engine,
+                        replica_id=0,
+                    )
+                ),
+                host="127.0.0.1",
+            )
+            port = server.start()
+            try:
+                url = f"http://127.0.0.1:{port}/metrics"
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"] == CONTENT_TYPE
+                    body = resp.read().decode()
+                assert 'minbft_requests_executed_total{replica="0"} 3' in body
+                assert "minbft_stage_latency_seconds_bucket" in body
+                assert 'stage="commit_quorum"' in body
+                assert "minbft_verify_queue_items_total" in body
+                assert "minbft_verify_queue_flushes_total" in body
+                assert "minbft_verify_queue_depth" in body
+
+                # the one-shot scrape helper (what `peer metrics` calls)
+                scraped = scrape(f"127.0.0.1:{port}")
+                assert 'minbft_requests_executed_total{replica="0"} 3' in scraped
+                assert "minbft_stage_latency_seconds_bucket" in scraped
+
+                # unknown paths 404 instead of leaking anything
+                with pytest.raises(urllib.error.HTTPError):
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/secrets", timeout=10
+                    )
+                return port, body
+            finally:
+                server.stop()
+        finally:
+            await client.stop()
+            for r in replicas:
+                await r.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_metrics_subcommand_scrapes(capsys):
+    """`peer metrics host:port` prints the exposition text (the scrape
+    path an operator uses without any Prometheus server)."""
+    from minbft_tpu.sample.peer import cli
+
+    server = MetricsServer(
+        lambda: render_families(
+            [("minbft_up", "gauge", "smoke", [({}, 1)])]
+        ),
+        host="127.0.0.1",
+    )
+    port = server.start()
+    try:
+        rc = cli.main(["metrics", f"127.0.0.1:{port}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "minbft_up 1" in out
+    finally:
+        server.stop()
+    # a dead endpoint is a clean error, not a traceback
+    rc = cli.main(["metrics", f"127.0.0.1:{port}", "--timeout", "0.5"])
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# bench-keys regression: tracing disabled == tracing absent
+
+
+def _bench_cluster_keys(trace: bool):
+    os.environ.setdefault("MINBFT_BENCH_SKIP_PREFLIGHT", "1")
+    import bench
+
+    out = asyncio.run(
+        bench._bench_cluster(
+            4, 1, 24,
+            n_clients=4,
+            usig_kind="hmac",
+            max_batch=8,
+            depth=4,
+            prefix="pin",
+            trace=trace,
+        )
+    )
+    return set(out)
+
+
+# The exact key set _bench_cluster emitted BEFORE the flight recorder
+# existed: a tracing-DISABLED run must reproduce it byte-identically —
+# the recorder must be invisible unless asked for.
+_PINNED_BENCH_KEYS = {
+    "pin_request_latency_p50_ms",
+    "pin_request_latency_p99_ms",
+    "pin_exec_latency_p50_ms",
+    "pin_exec_latency_p99_ms",
+    "pin_messages_handled",
+    "pin_messages_dropped",
+    "pin_n",
+    "pin_f",
+    "pin_clients",
+    "pin_requests",
+    "pin_committed_req_per_sec",
+    "pin_batched_verifies",
+    "pin_batches",
+    "pin_mean_batch",
+    "pin_device_verifies_per_sec",
+    "pin_logical_verifies",
+    "pin_memo_hits",
+    "pin_hmac_sha256_prep_share",
+    # REPLY signing rides the engine sign queue even on the CPU backend
+    # (host fallback, recorded) — these four predate the recorder.
+    "pin_device_signs_per_sec",
+    "pin_queue_signs",
+    "pin_sign_fallback_items",
+    "pin_sign_share",
+}
+
+
+@pytest.mark.slow
+def test_bench_keys_trace_disabled_is_byte_identical():
+    keys = _bench_cluster_keys(trace=False)
+    assert keys == _PINNED_BENCH_KEYS
+    assert not any("_stage_" in k for k in keys)
+
+
+@pytest.mark.slow
+def test_bench_keys_trace_enabled_adds_only_stage_keys():
+    keys = _bench_cluster_keys(trace=True)
+    extra = keys - _PINNED_BENCH_KEYS
+    assert extra, "traced run must add stage keys"
+    assert all("pin_stage_" in k for k in extra), sorted(extra)
+    # and the replica pipeline is fully attributed
+    for name in ("verify_done", "commit_quorum", "execute", "reply_sent"):
+        assert f"pin_stage_{name}_p50_ms" in keys
+        assert f"pin_stage_{name}_share" in keys

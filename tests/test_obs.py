@@ -1,0 +1,358 @@
+"""Flight-recorder subsystem tests (minbft_tpu/obs, ISSUE 4): ring
+semantics under concurrency, histogram correctness against the reservoir
+oracle, recorder pairing, and the dump→ingest stage table."""
+
+import asyncio
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from minbft_tpu.obs.hist import Log2Histogram
+from minbft_tpu.obs.trace import (
+    CLIENT_STAGES,
+    REPLICA_STAGES,
+    FlightRecorder,
+    MTStageRing,
+    StageRing,
+    dump_recorder,
+    load_dumps,
+    stage_table,
+)
+from minbft_tpu.utils.metrics import LatencyReservoir
+
+
+# ---------------------------------------------------------------------------
+# rings
+
+
+def test_stage_ring_orders_and_wraps():
+    r = StageRing(capacity=8)
+    assert r.capacity == 8
+    for k in range(5):
+        r.push(1, k, 2, 100 + k)
+    assert len(r) == 5
+    assert [e[1] for e in r.snapshot()] == [0, 1, 2, 3, 4]
+    for k in range(5, 20):
+        r.push(1, k, 2, 100 + k)
+    # wrapped: only the newest `capacity` events remain, still in order
+    assert len(r) == 8
+    assert [e[1] for e in r.snapshot()] == list(range(12, 20))
+    assert [e[1] for e in r.snapshot(limit=3)] == [17, 18, 19]
+
+
+def test_stage_ring_capacity_rounds_to_power_of_two():
+    assert StageRing(capacity=100).capacity == 128
+    assert MTStageRing(capacity=100).capacity == 128
+
+
+def test_mt_ring_multi_producer_hammer():
+    """Engine-worker-shaped hammer: several OS threads push concurrently;
+    every surviving row must be internally consistent (a torn row — one
+    thread's column interleaved into another's — would break the a+b==c
+    invariant each producer maintains)."""
+    ring = MTStageRing(capacity=1024)
+    n_threads, per_thread = 8, 3000
+
+    def producer(tid: int) -> None:
+        for k in range(per_thread):
+            ring.push(tid, k, tid + k, tid * 1_000_000 + k)
+
+    threads = [
+        threading.Thread(target=producer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = ring.snapshot()
+    assert len(snap) == 1024  # saturated
+    per_tid_last = {}
+    for a, b, c, t in snap:
+        assert 0 <= a < n_threads
+        assert c == a + b, "torn row: columns from different producers"
+        assert t == a * 1_000_000 + b
+        # per-producer order is preserved (the lock serializes pushes)
+        assert per_tid_last.get(a, -1) < b
+        per_tid_last[a] = b
+
+
+def test_mt_ring_event_loop_plus_worker_threads():
+    """The deployment shape: the event loop and asyncio.to_thread
+    workers (engine dispatcher stand-ins) produce into one ring while
+    the loop also drains snapshots mid-flight."""
+
+    async def run():
+        ring = MTStageRing(capacity=4096)
+
+        def worker(tid: int) -> None:
+            for k in range(500):
+                ring.push(tid, k, tid + k, k)
+
+        async def loop_producer() -> None:
+            for k in range(500):
+                ring.push(99, k, 99 + k, k)
+                if k % 50 == 0:
+                    for a, b, c, _ in ring.snapshot(limit=64):
+                        assert c == a + b
+                    await asyncio.sleep(0)
+
+        await asyncio.gather(
+            loop_producer(),
+            *[asyncio.to_thread(worker, t) for t in range(4)],
+        )
+        snap = ring.snapshot()
+        assert len(snap) == 4 * 500 + 500  # nothing lost below capacity
+        for a, b, c, _ in snap:
+            assert c == a + b
+
+    asyncio.run(run())
+
+
+def test_engine_worker_ring_records_dispatch_spans():
+    """The engine's _note_prep pushes dispatcher span events from worker
+    threads into its MTStageRing; drain decodes queue names."""
+    from minbft_tpu.parallel import BatchVerifier
+
+    async def run():
+        eng = BatchVerifier(max_batch=8, buckets=(8,))
+        eng.enable_obs_ring(capacity=256)
+        key, msg, mac = b"\x11" * 32, b"\x22" * 32, b"\x33" * 32
+        import hashlib
+        import hmac as hmac_mod
+
+        good = hmac_mod.new(key, msg, hashlib.sha256).digest()
+        oks = await asyncio.gather(
+            *[eng.verify_hmac_sha256(key, msg, good) for _ in range(8)]
+        )
+        assert all(oks)
+        events = eng.drain_obs_events()
+        assert events, "no dispatcher span events recorded"
+        names = {e[0] for e in events}
+        assert names == {"hmac_sha256"}
+        for _name, pad, prep_ns, t_ns in events:
+            assert pad >= 0 and prep_ns >= 0 and t_ns > 0
+        # disabled engines pay one attribute check and record nothing
+        eng2 = BatchVerifier(max_batch=8, buckets=(8,))
+        assert eng2.drain_obs_events() == []
+
+    asyncio.run(run())
+
+
+def test_engine_flush_reasons_and_occupancy_sum_to_batches():
+    from minbft_tpu.parallel import BatchVerifier
+
+    async def run():
+        eng = BatchVerifier(max_batch=4, buckets=(4,))
+        import hashlib
+        import hmac as hmac_mod
+
+        key, msg = b"\x01" * 32, b"\x02" * 32
+        good = hmac_mod.new(key, msg, hashlib.sha256).digest()
+        # distinct MACs so nothing dedups away
+        items = [
+            (key, msg, good[:-1] + bytes([i])) for i in range(16)
+        ] + [(key, msg, good)]
+        await asyncio.gather(
+            *[eng.verify_hmac_sha256(*it) for it in items]
+        )
+        st = eng.stats["hmac_sha256"]
+        assert st.batches >= 1
+        assert sum(st.flush_reasons.values()) == st.batches
+        assert sum(st.occupancy.values()) == st.batches
+        assert set(st.flush_reasons) <= {
+            "full", "idle", "timer", "completion", "direct"
+        }
+        assert eng.queue_depths()["hmac_sha256"] == 0  # drained
+        assert eng.sign_queue_depths() == {}
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# histograms
+
+
+def test_log2_histogram_bucket_edges():
+    h = Log2Histogram()
+    h.observe(0.5e-6)   # <= 1us -> bucket 0
+    h.observe(1e-6)     # == 1us -> bucket 0
+    h.observe(2e-6)     # bucket 1
+    h.observe(3e-6)     # bucket 2 (2 < 3 <= 4)
+    assert h.buckets[0] == 2 and h.buckets[1] == 1 and h.buckets[2] == 1
+    assert h.count == 4
+    h.observe(-1.0)  # clock weirdness clamps, never corrupts
+    assert h.buckets[0] == 3
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_histogram_percentile_vs_reservoir_oracle(seed):
+    """Property: on identical samples the histogram's percentile is the
+    nearest-rank value rounded UP to its bucket edge — within a factor
+    of 2 above the reservoir oracle's exact answer, never below it."""
+    rng = random.Random(seed)
+    hist = Log2Histogram()
+    oracle = LatencyReservoir(capacity=10_000)  # holds every sample
+    samples = []
+    for _ in range(3000):
+        # log-uniform over ~1us..10s — the range of real stage spans
+        v = 10 ** rng.uniform(-6, 1)
+        samples.append(v)
+        hist.observe(v)
+        oracle.observe(v)
+    assert hist.count == oracle.count == 3000
+    assert abs(hist.total_s - sum(samples)) < 1e-6 * hist.count
+    for q in (1, 25, 50, 90, 99):
+        exact = oracle.percentile(q)
+        approx = hist.percentile(q)
+        assert exact * (1 - 1e-9) <= approx <= exact * 2 + 2e-6, (
+            q, exact, approx,
+        )
+
+
+def test_histogram_merge_equals_concatenation():
+    rng = random.Random(7)
+    a, b, both = Log2Histogram(), Log2Histogram(), Log2Histogram()
+    for i in range(2000):
+        v = 10 ** rng.uniform(-6, 0)
+        (a if i % 2 else b).observe(v)
+        both.observe(v)
+    merged = Log2Histogram.merged([a, b])
+    assert merged.buckets == both.buckets
+    assert merged.count == both.count
+    assert abs(merged.total_s - both.total_s) < 1e-9
+    for q in (50, 99):
+        assert merged.percentile(q) == both.percentile(q)
+
+
+def test_histogram_dict_round_trip():
+    h = Log2Histogram()
+    for v in (1e-6, 5e-4, 0.25, 3.0):
+        h.observe(v)
+    d = json.loads(json.dumps(h.to_dict()))  # survives JSON
+    h2 = Log2Histogram.from_dict(d)
+    assert h2.buckets == h.buckets and h2.count == h.count
+    assert abs(h2.total_s - h.total_s) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# recorder pairing + stage table
+
+
+def test_recorder_pairs_consecutive_points_and_retires_keys():
+    rec = FlightRecorder.for_replica(0)
+    assert rec.stages == REPLICA_STAGES
+    for stage in range(len(REPLICA_STAGES)):
+        rec.note(stage, 5, 42)
+    hists = rec.stage_hists()
+    # the entry point has no predecessor: 7 spans for 8 points
+    assert set(hists) == set(REPLICA_STAGES[1:])
+    assert all(h.count == 1 for h in hists.values())
+    assert rec._last == {}, "final stage must retire the pairing key"
+    assert len(rec.ring) == len(REPLICA_STAGES)
+
+
+def test_recorder_inflight_keys_are_bounded():
+    from minbft_tpu.obs import trace as trace_mod
+
+    rec = FlightRecorder.for_replica(0)
+    cap = trace_mod._MAX_INFLIGHT_KEYS
+    for k in range(cap + 10):  # never-completing requests
+        rec.note(0, 0, k)
+    assert len(rec._last) <= cap
+
+
+def test_stage_table_from_dumped_recorders(tmp_path):
+    base = str(tmp_path / "trace")
+    for rid in (0, 1):
+        rec = FlightRecorder.for_replica(rid)
+        for seq in range(10):
+            for stage in range(len(REPLICA_STAGES)):
+                rec.note(stage, 1, seq)
+        assert dump_recorder(rec, base=base) is not None
+    crec = FlightRecorder.for_client(1)
+    for seq in range(10):
+        for stage in range(len(CLIENT_STAGES)):
+            crec.note(stage, 1, seq)
+    dump_recorder(crec, base=base)
+
+    docs = load_dumps(base)
+    assert len(docs) == 3
+    table = stage_table(docs, "t")
+    for name in REPLICA_STAGES[1:]:
+        assert f"t_stage_{name}_p50_ms" in table
+        assert f"t_stage_{name}_share" in table
+    for name in CLIENT_STAGES[1:]:
+        assert f"t_stage_client_{name}_p50_ms" in table
+        # client spans overlap the replica pipeline: no share key
+        assert f"t_stage_client_{name}_share" not in table
+    shares = [v for k, v in table.items() if k.endswith("_share")]
+    assert abs(sum(shares) - 1.0) < 0.01
+
+    # empty dumps (tracing off) produce NO keys — the bench's
+    # byte-identical-keys contract
+    assert stage_table([], "t") == {}
+    assert stage_table([{"kind": "replica", "hists": {}}], "t") == {}
+
+
+def test_tracing_enabled_env_parsing(monkeypatch):
+    """MINBFT_TRACE follows the repo's env-flag convention: the usual
+    falsy spellings DISABLE; MINBFT_TRACE_DUMP is a path (any non-empty
+    value enables)."""
+    from minbft_tpu.obs.trace import tracing_enabled
+
+    monkeypatch.delenv("MINBFT_TRACE", raising=False)
+    monkeypatch.delenv("MINBFT_TRACE_DUMP", raising=False)
+    assert not tracing_enabled()
+    for off in ("0", "false", "no", ""):
+        monkeypatch.setenv("MINBFT_TRACE", off)
+        assert not tracing_enabled(), off
+    monkeypatch.setenv("MINBFT_TRACE", "1")
+    assert tracing_enabled()
+    monkeypatch.setenv("MINBFT_TRACE", "0")
+    monkeypatch.setenv("MINBFT_TRACE_DUMP", "/tmp/somewhere")
+    assert tracing_enabled()
+
+
+def test_flush_reasons_skip_failed_dispatches():
+    """The 'flush_reasons and occupancy both sum to batches' invariant
+    must hold on error paths: a batch whose dispatch raises is counted
+    in none of the three."""
+    import asyncio as aio
+
+    from minbft_tpu.parallel import BatchVerifier
+    from minbft_tpu.parallel.engine import _SchemeQueue
+
+    async def run():
+        eng = BatchVerifier(max_batch=4, buckets=(4,), dispatch_timeout=0)
+
+        def boom(items):
+            raise RuntimeError("dispatch exploded")
+
+        q = _SchemeQueue(eng, "boom", boom)
+        eng._queues["boom"] = q
+        with pytest.raises(RuntimeError):
+            await q.submit((b"x",))
+        assert q.stats.batches == 0
+        assert sum(q.stats.flush_reasons.values()) == 0
+        assert sum(q.stats.occupancy.values()) == 0
+
+    aio.run(run())
+
+
+def test_dump_respects_env_and_noop_when_unset(tmp_path, monkeypatch):
+    from minbft_tpu.obs import trace as trace_mod
+
+    rec = FlightRecorder.for_replica(3)
+    rec.note(0, 1, 1)
+    monkeypatch.delenv(trace_mod.TRACE_DUMP_ENV, raising=False)
+    assert dump_recorder(rec) is None  # env unset, explicit base absent
+    monkeypatch.setenv(trace_mod.TRACE_DUMP_ENV, str(tmp_path / "envtrace"))
+    path = dump_recorder(rec)
+    assert path is not None and path.endswith(".r3.json")
+    assert os.path.exists(path)
+    doc = load_dumps(str(tmp_path / "envtrace"))[0]
+    assert doc["kind"] == "replica" and doc["id"] == 3
+    assert doc["events"], "ring events must land in the dump"

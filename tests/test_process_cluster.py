@@ -552,3 +552,101 @@ def test_killed_replica_rejoins_after_restart(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+def test_metrics_port_served_and_scraped_by_peer_metrics(tmp_path):
+    """Acceptance (ISSUE 4): `peer run --metrics-port` serves Prometheus
+    text from a REAL replica process, the `peer metrics` subcommand
+    scrapes it, and a SIGTERM shutdown writes the MINBFT_TRACE_DUMP
+    JSON the flight recorder promised."""
+    import re
+    import urllib.request
+
+    d = str(tmp_path)
+    trace_base = f"{d}/trace"
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        MINBFT_TRACE_DUMP=trace_base,  # recorder on + dump at shutdown
+    )
+    base_port = _free_base_port(3)
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "3", "-d", d, "--base-port", str(base_port),
+         "--usig", "SOFT_ECDSA"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = []
+    logs = []
+    try:
+        for i in range(3):
+            log = open(f"{d}/replica{i}.log", "wb")
+            logs.append(log)
+            cmd = [sys.executable, "-m", "minbft_tpu.sample.peer",
+                   "--keys", f"{d}/keys.yaml",
+                   "--config", f"{d}/consensus.yaml",
+                   "run", str(i), "--no-batch"]
+            if i == 0:
+                cmd += ["--metrics-port", "0"]  # 0 = pick a free port
+            replicas.append(
+                subprocess.Popen(env=env, args=cmd,
+                                 stdout=subprocess.DEVNULL, stderr=log)
+            )
+        assert _wait_ports([base_port + i for i in range(3)]), "never bound"
+        assert _wait_for_log([f"{d}/replica0.log"], b"/metrics", 30), (
+            "replica 0 never announced its metrics endpoint"
+        )
+        mport = int(
+            re.search(
+                rb"metrics on http://[^:]+:(\d+)/metrics",
+                open(f"{d}/replica0.log", "rb").read(),
+            ).group(1)
+        )
+
+        req = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "metrics-op", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert req.returncode == 0, req.stderr
+
+        # the `peer metrics` subcommand scrapes the live endpoint
+        scrape = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "metrics", f"127.0.0.1:{mport}"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert scrape.returncode == 0, scrape.stderr
+        assert 'minbft_requests_executed_total{replica="0"} 1' in scrape.stdout
+        assert "minbft_stage_latency_seconds_bucket" in scrape.stdout
+        assert 'stage="commit_quorum"' in scrape.stdout
+        # raw HTTP agrees on the content type (Prometheus text 0.0.4)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=10
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+
+        # graceful SIGTERM shutdown writes the per-replica trace dump
+        replicas[0].terminate()
+        replicas[0].wait(timeout=30)
+        dump = f"{trace_base}.r0.json"
+        assert os.path.exists(dump), os.listdir(d)
+        doc = json.load(open(dump))
+        assert doc["kind"] == "replica" and doc["id"] == 0
+        assert doc["hists"], "stage histograms must land in the dump"
+        assert doc["events"], "ring events must land in the dump"
+    finally:
+        for p in replicas:
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -224,6 +224,10 @@ def default_config() -> AnalyzeConfig:
                     # worker threads and must hold _stats_lock.
                     "_sign_queues.stats.padded_lanes",
                     "_sign_queues.stats.host_prep_time_s",
+                    # Obs-ring queue-name interning: _obs_queue_id runs
+                    # on worker threads too (lock-free read, locked
+                    # insert).
+                    "_obs_queue_ids",
                 ),
                 mode="threads",
             ),
@@ -263,6 +267,36 @@ def default_config() -> AnalyzeConfig:
                 locks=(),
                 guarded=("pending",),
             ),
+            # Flight-recorder rings (obs/trace.py, ISSUE 4).  StageRing
+            # is SINGLE-writer by contract — only the owning event loop
+            # pushes — so it is loop-confined with no lock; MTStageRing
+            # subclasses it for the engine's worker threads, wrapping
+            # push/snapshot in `with self._lock` (the storage writes
+            # live in StageRing's sync bodies, serialized by the
+            # subclass's lock wrappers — the same locked-writes
+            # discipline as the engine stats; the multi-producer hammer
+            # in tests/test_obs.py pins the torn-row invariant).
+            LockClassSpec(
+                path="minbft_tpu/obs/trace.py",
+                cls="StageRing",
+                locks=(),
+                guarded=("_a", "_b", "_c", "_t", "_idx", "_n"),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/obs/trace.py",
+                cls="MTStageRing",
+                locks=("_lock",),
+                guarded=("_a", "_b", "_c", "_t", "_idx", "_n"),
+                mode="threads",
+            ),
+            # The recorder's pairing map is event-loop confined like the
+            # ring it feeds (note() is sync — loop-atomic end to end).
+            LockClassSpec(
+                path="minbft_tpu/obs/trace.py",
+                cls="FlightRecorder",
+                locks=(),
+                guarded=("_last",),
+            ),
             # The software USIG's counter is certified-then-incremented
             # under a real threading.Lock (reference ecallLock).
             LockClassSpec(
@@ -274,7 +308,10 @@ def default_config() -> AnalyzeConfig:
             ),
         ),
         trace=TracePurityConfig(
-            roots=("minbft_tpu/ops", "minbft_tpu/parallel"),
+            # obs/ included (ISSUE 4): no flight-recorder hook may be
+            # reachable from jitted code — the pass verifies obs/ holds
+            # no jit roots and nothing traced calls into it.
+            roots=("minbft_tpu/ops", "minbft_tpu/parallel", "minbft_tpu/obs"),
             # FieldSpec bundles host-static field constants (moduli,
             # Montgomery R^2, …) — see ops/limbs.py.
             static_types=("int", "float", "bool", "str", "bytes", "FieldSpec"),
