@@ -13,16 +13,47 @@
 #                    pyproject.toml) or pyflakes when installed
 #   make fast        native + lint + the unit tier of the test suite (<2min)
 #   make check       native + lint + the FULL test suite (~9min, what CI runs)
+#   make check-race  race tier (VERDICT #5): native usig_test rebuilt and
+#                    run under ThreadSanitizer (concurrent certification
+#                    hammer); skips with a notice if the toolchain lacks
+#                    TSan.  The Python-side race tier is the CI obs/chaos
+#                    steps under PYTHONDEVMODE=1.
+#   make chaos       the seeded chaos suite (tests/test_chaos.py) under
+#                    PYTHONDEVMODE=1 + faulthandler; export
+#                    MINBFT_CHAOS_SEED to replay a failed schedule
 #   make bench       the driver's bench entry point (real TPU)
 #
 # Tests force the CPU backend with 8 virtual devices via tests/conftest.py.
 
 PY ?= python
+CXX ?= g++
 
-.PHONY: native lint fast check test bench clean
+.PHONY: native lint fast check check-race chaos test bench clean
 
 native:
 	$(MAKE) -C minbft_tpu/native
+
+# Probe TSan availability with a throwaway compile; a toolchain without
+# it (or without the tsan runtime) skips WITH NOTICE instead of failing,
+# so the target is safe to wire into any environment's check run.
+check-race:
+	@probe=$$(mktemp -d); \
+	printf 'int main(){return 0;}\n' > $$probe/t.cc; \
+	if $(CXX) -fsanitize=thread -o $$probe/t $$probe/t.cc 2>/dev/null; then \
+	    rm -rf $$probe; \
+	    $(MAKE) -C minbft_tpu/native check-race; \
+	else \
+	    rm -rf $$probe; \
+	    echo "check-race: SKIPPED — toolchain lacks ThreadSanitizer" \
+	         "(install gcc/clang tsan runtime to enable the race tier)"; \
+	fi
+
+# The seeded chaos suite: deterministic fault injection + Byzantine
+# adversaries + the n=4/f=1 soak, under dev-mode asserts with
+# faulthandler armed (a wedged loop dumps stacks instead of hanging).
+chaos:
+	PYTHONDEVMODE=1 PYTHONFAULTHANDLER=1 $(PY) -X faulthandler \
+	    -m pytest tests/test_chaos.py -q
 
 # compileall is the always-available floor; tools/analyze hard-fails on
 # any non-baselined finding of its five passes; ruff/pyflakes layer on

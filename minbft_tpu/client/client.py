@@ -34,7 +34,7 @@ from typing import AsyncIterator, Dict, Optional
 
 from .. import api
 from ..obs import trace as obs_trace
-from ..utils.backoff import ReconnectBackoff
+from ..utils.backoff import ReconnectBackoff, RetransmitBackoff
 from ..messages import (
     CodecError,
     Reply,
@@ -507,13 +507,18 @@ class Client:
     async def _await_with_retransmit(
         self, pending: _PendingRequest, data: bytes, timeout: Optional[float]
     ) -> bytes:
-        """Periodically re-send the request until resolved — the network may
-        drop messages (the reference relies on its stream replay design,
+        """Re-send the request until resolved — the network may drop
+        messages (the reference relies on its stream replay design,
         core/message-handling.go:316-350 HELLO log replay, for the peer side;
-        clients get retransmission here)."""
+        clients get retransmission here).  Intervals climb a capped
+        exponential ladder with jitter (utils.backoff.RetransmitBackoff):
+        a fixed interval re-broadcast every unresolved pipelined request
+        in the same tick, which under loss or partition turned the
+        recovery path itself into a synchronized load spike."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        backoff = RetransmitBackoff(self._retransmit_interval)
         while True:
-            interval = self._retransmit_interval
+            interval = backoff.next_delay()
             if deadline is not None:
                 interval = min(interval, max(deadline - time.monotonic(), 0.001))
             try:

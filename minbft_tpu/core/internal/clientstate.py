@@ -27,12 +27,17 @@ class ClientState:
     seq watermarks, request-seq.go:28-45)."""
 
     # Out-of-order tolerance window: completed-but-unretired seqs above
-    # the retire watermark are remembered individually so a LOWER seq
+    # the retire floor are remembered individually so a LOWER seq
     # arriving late is not mistaken for a duplicate.  Bounded at roughly
     # any sane client pipeline depth; beyond it, oldest entries fall out
-    # (dedup degrades to the watermark for ancient seqs — the reply
+    # (dedup degrades to the floor for ancient seqs — the reply
     # window's philosophy).
     _DONE_WINDOW = 1024
+
+    # Executed-seq dedup window (see retire_request_seq): retirement is
+    # EXACT per seq, bounded by this window; evicted seqs raise the
+    # retire floor (conservative refusal, like the done-floor).
+    _RETIRE_WINDOW = 1024
 
     def __init__(self, timer_provider: TimerProvider):
         self._timers = timer_provider
@@ -58,7 +63,18 @@ class ClientState:
         # liveness, and only beyond a 1024-deep reorder).
         self._done_floor = 0
         self._last_prepared = 0
-        self._retired = 0
+        # Executed-seq state: the reference retires by WATERMARK JUMP
+        # (executing seq k marks every lower seq of the client retired,
+        # request-seq.go:108-112) — sound for its strictly serial
+        # clients, where a lower seq after a higher one can only be a
+        # stale retry.  This build's clients pipeline: under network
+        # reordering a higher seq can commit FIRST, and a jump would
+        # silently supersede the still-live lower request — never
+        # executed, never replied, the client wedged until timeout (the
+        # chaos soak caught this live).  Retirement is therefore exact:
+        # a bounded set of executed seqs over a floor raised by eviction.
+        self._retire_floor = 0
+        self._retired_set: set = set()
         self._cond = asyncio.Condition()
         # Reply buffer: a bounded WINDOW of recent replies.  The reference
         # keeps exactly one last-reply slot (reply.go:25-38) — sound there
@@ -70,18 +86,28 @@ class ClientState:
         # bounds memory at O(_REPLY_WINDOW) per client while covering any
         # sane pipeline depth; the event is swapped on each add so waiters
         # from any earlier add are woken exactly once.
-        self._last_replied_seq = 0
+        self._reply_floor = 0  # highest seq pruned out of the window
         self._replies: "OrderedDict[int, object]" = OrderedDict()
         self._reply_event = asyncio.Event()
-        # timers (reference timeout.go)
-        self._request_timer = None
-        self._prepare_timer = None
+        # Timers (reference timeout.go) — PER-SEQ, not the reference's
+        # single slot per client: pipelined clients keep many requests
+        # in flight, and a shared slot means every newly-applied request
+        # DISARMS the watchdog guarding the previous one (and executing
+        # any request disarms them all) — under faults the unguarded
+        # requests then starve with no view-change demand ever fired
+        # (the chaos soak wedged on this).  Bounded by requests in
+        # flight: entries leave on expiry, stop, or execution.
+        self._request_timers: Dict[int, object] = {}
+        self._prepare_timers: Dict[int, object] = {}
 
     # -- request sequence lifecycle -----------------------------------------
 
+    def _is_retired(self, seq: int) -> bool:
+        return seq <= self._retire_floor or seq in self._retired_set
+
     def _is_dup(self, seq: int) -> bool:
         return (
-            seq <= self._retired
+            self._is_retired(seq)
             or seq <= self._done_floor
             or seq == self._active
             or seq in self._done
@@ -118,7 +144,7 @@ class ClientState:
             if seq != self._active:
                 raise ValueError("release of non-captured request seq")
             self._active = 0
-            if seq > self._retired:
+            if not self._is_retired(seq):
                 self._done.add(seq)
                 if len(self._done) > self._DONE_WINDOW:
                     evicted = min(self._done)
@@ -144,17 +170,46 @@ class ClientState:
 
     def retire_request_seq(self, seq: int) -> bool:
         """Mark ``seq`` executed; returns False if already retired
-        (reference request-seq.go:108-112).  The watermark-jump semantics
-        are preserved — the collector executes in a deterministic global
-        order, so seqs below an executed one are genuinely superseded —
-        and completed seqs at or below the new watermark leave the done
-        set (memory stays O(pipeline depth))."""
-        if seq <= self._retired:
+        (reference request-seq.go:108-112).
+
+        EXACT per-seq retirement, NOT the reference's watermark jump: the
+        collector executes in a deterministic global (view, cv) order,
+        and with pipelined clients plus a reordering network a higher seq
+        legitimately commits before a lower one — jumping would silently
+        drop the lower request (never executed, never replied; the chaos
+        soak wedged on exactly this).  The set is a pure function of the
+        executed history — identical on every correct replica, so the
+        checkpoint watermark digest stays aligned — and bounded: evicted
+        seqs raise the floor (an ancient retransmit below the floor is
+        refused as a duplicate, a liveness-only loss beyond a
+        _RETIRE_WINDOW-deep reorder)."""
+        if self._is_retired(seq):
             return False
-        self._retired = seq
-        if self._done:
-            self._done = {s for s in self._done if s > seq}
+        self._retired_set.add(seq)
+        self._fold_retire_floor()
+        while len(self._retired_set) > self._RETIRE_WINDOW:
+            evicted = min(self._retired_set)
+            self._retired_set.discard(evicted)
+            if evicted > self._retire_floor:
+                self._retire_floor = evicted
+            self._fold_retire_floor()
+        self._done.discard(seq)
         return True
+
+    def _fold_retire_floor(self) -> None:
+        """Collapse the contiguous executed prefix into the floor: floor
+        semantics ("everything at or below is retired") are EXACT for a
+        contiguous run, so keeping those seqs individually would only
+        bloat every checkpoint digest and snapshot with up to
+        _RETIRE_WINDOW (client, seq) pairs per client.  Clients allocate
+        seqs serially from seq_start, so once an eviction (or in-order
+        execution from a floor-adjacent start) lands the floor inside
+        the run, the set stays near-empty.  Deterministic — a pure
+        function of the set — so replicas' watermark digests stay
+        aligned."""
+        while self._retire_floor + 1 in self._retired_set:
+            self._retire_floor += 1
+            self._retired_set.discard(self._retire_floor)
 
     @property
     def last_captured_seq(self) -> int:
@@ -162,21 +217,34 @@ class ClientState:
 
     @property
     def retired_seq(self) -> int:
-        return self._retired
+        """Highest executed seq (diagnostic)."""
+        return max(self._retired_set, default=self._retire_floor)
 
-    def install_retired_seq(self, seq: int) -> None:
-        """State transfer: adopt a certified retire watermark.  The other
-        lifecycle watermarks advance to match so a re-offered old request
-        dedups instead of re-capturing."""
-        if seq <= self._retired:
-            return
-        self._retired = seq
+    @property
+    def retire_state(self):
+        """(floor, sorted retired seqs above it) — the exact executed-seq
+        state carried by checkpoints and state transfer."""
+        return self._retire_floor, tuple(sorted(self._retired_set))
+
+    def install_retired(self, floor: int, seqs) -> None:
+        """State transfer: adopt a certified retire state.  Union with
+        local facts (an executed seq stays executed), then advance the
+        other lifecycle watermarks so a re-offered old request dedups
+        instead of re-capturing."""
+        if floor > self._retire_floor:
+            self._retire_floor = floor
+        self._retired_set.update(seqs)
+        self._retired_set = {
+            s for s in self._retired_set if s > self._retire_floor
+        }
+        self._fold_retire_floor()
+        top = max(self._retired_set, default=self._retire_floor)
         if self._done:
-            self._done = {s for s in self._done if s > seq}
-        if self._last_captured < seq:
-            self._last_captured = seq
-        if self._last_prepared < seq:
-            self._last_prepared = seq
+            self._done = {s for s in self._done if not self._is_retired(s)}
+        if self._last_captured < top:
+            self._last_captured = top
+        if self._last_prepared < top:
+            self._last_prepared = top
 
     # -- reply buffer --------------------------------------------------------
 
@@ -185,49 +253,76 @@ class ClientState:
     def add_reply(self, seq: int, reply) -> None:
         """Store the reply in the bounded window and wake subscribers
         (reference reply.go:41-60, generalized for pipelined clients —
-        see the constructor comment)."""
-        if seq <= self._last_replied_seq and seq not in self._replies:
-            return  # stale (reference AddReply "old request ID")
+        see the constructor comment).  Out-of-order seqs are accepted:
+        with exact retirement a lower seq legitimately EXECUTES after a
+        higher one (reordered commits), so its first reply arriving
+        "late" is fresh, not a stale retry — only seqs already replied or
+        pruned below the window floor are dropped."""
+        if seq in self._replies or seq <= self._reply_floor:
+            return  # duplicate / pruned (reference "old request ID")
         self._replies[seq] = reply
-        if seq > self._last_replied_seq:
-            self._last_replied_seq = seq
         while len(self._replies) > self._REPLY_WINDOW:
-            self._replies.popitem(last=False)
+            old, _ = self._replies.popitem(last=False)
+            if old > self._reply_floor:
+                self._reply_floor = old
         ev, self._reply_event = self._reply_event, asyncio.Event()
         ev.set()
 
     async def reply_for(self, seq: int) -> Optional[object]:
         """Await the reply for ``seq`` (reference reply.go:62-80
-        ReplyChannel): waits until the client's replied watermark reaches
-        ``seq``; returns None if ``seq`` was pruned out of the window (a
-        stale retry far behind the pipeline — the reference closes the
-        channel without sending)."""
-        while self._last_replied_seq < seq:
+        ReplyChannel): waits until the reply lands in the window; returns
+        None if ``seq`` was pruned out of it (a stale retry far behind
+        the pipeline — the reference closes the channel without
+        sending)."""
+        while True:
+            reply = self._replies.get(seq)
+            if reply is not None:
+                return reply
+            if seq <= self._reply_floor:
+                return None
             await self._reply_event.wait()
-        return self._replies.get(seq)
 
     # -- timers --------------------------------------------------------------
 
-    def start_request_timer(self, timeout: float, on_expiry: Callable[[], None]) -> None:
-        """(Re)start the single-slot request timer (reference timeout.go:40-56)."""
-        self.stop_request_timer()
+    def _start_timer(
+        self,
+        timers: Dict[int, object],
+        seq: int,
+        timeout: float,
+        on_expiry: Callable[[], None],
+    ) -> None:
+        self._stop_timer(timers, seq)
         if timeout > 0:
-            self._request_timer = self._timers.after(timeout, on_expiry)
 
-    def stop_request_timer(self) -> None:
-        if self._request_timer is not None:
-            self._request_timer.cancel()
-            self._request_timer = None
+            def fire() -> None:
+                timers.pop(seq, None)
+                on_expiry()
 
-    def start_prepare_timer(self, timeout: float, on_expiry: Callable[[], None]) -> None:
-        self.stop_prepare_timer()
-        if timeout > 0:
-            self._prepare_timer = self._timers.after(timeout, on_expiry)
+            timers[seq] = self._timers.after(timeout, fire)
 
-    def stop_prepare_timer(self) -> None:
-        if self._prepare_timer is not None:
-            self._prepare_timer.cancel()
-            self._prepare_timer = None
+    @staticmethod
+    def _stop_timer(timers: Dict[int, object], seq: int) -> None:
+        t = timers.pop(seq, None)
+        if t is not None:
+            t.cancel()
+
+    def start_request_timer(
+        self, seq: int, timeout: float, on_expiry: Callable[[], None]
+    ) -> None:
+        """(Re)start the request timer for ``seq`` (reference
+        timeout.go:40-56, per-seq — see the constructor note)."""
+        self._start_timer(self._request_timers, seq, timeout, on_expiry)
+
+    def stop_request_timer(self, seq: int) -> None:
+        self._stop_timer(self._request_timers, seq)
+
+    def start_prepare_timer(
+        self, seq: int, timeout: float, on_expiry: Callable[[], None]
+    ) -> None:
+        self._start_timer(self._prepare_timers, seq, timeout, on_expiry)
+
+    def stop_prepare_timer(self, seq: int) -> None:
+        self._stop_timer(self._prepare_timers, seq)
 
 
 class ClientStates:
@@ -254,18 +349,34 @@ class ClientStates:
         return self._clients.items()
 
     def retire_watermarks(self):
-        """Deterministic snapshot of per-client retire watermarks (sorted
-        (client_id, retired_seq), zero entries omitted) — part of the
-        composite checkpoint digest: the retired set is a pure function of
-        the executed history, so correct replicas agree on it at every
-        batch boundary."""
-        return tuple(
-            (cid, st.retired_seq)
-            for cid, st in sorted(self._clients.items())
-            if st.retired_seq > 0
-        )
+        """Deterministic snapshot of the per-client retire state — part
+        of the composite checkpoint digest: the retired set is a pure
+        function of the executed history, so correct replicas agree on it
+        at every batch boundary.
+
+        Encoding: flat sorted (client_id, seq) pairs — the wire/digest
+        shape predating exact retirement — where each client's FIRST pair
+        carries its retire floor and the following pairs its individually
+        retired seqs above the floor, ascending (all > floor, so the pair
+        stream stays sorted).  Clients with no executed history are
+        omitted.  Exactness matters: encoding only a max watermark would
+        make a state-transferred replica refuse a still-live lower seq
+        that up-to-date replicas later execute — a ledger fork."""
+        out = []
+        for cid, st in sorted(self._clients.items()):
+            floor, seqs = st.retire_state
+            if floor == 0 and not seqs:
+                continue
+            out.append((cid, floor))
+            out.extend((cid, s) for s in seqs)
+        return tuple(out)
 
     def install_retire_watermarks(self, marks) -> None:
-        """State transfer: adopt certified retire watermarks."""
+        """State transfer: adopt a certified retire state (the
+        :meth:`retire_watermarks` encoding — per client, floor first,
+        then the retired seqs above it)."""
+        by_client: Dict[int, list] = {}
         for cid, seq in marks:
-            self.client(cid).install_retired_seq(seq)
+            by_client.setdefault(cid, []).append(seq)
+        for cid, seqs in by_client.items():
+            self.client(cid).install_retired(seqs[0], seqs[1:])

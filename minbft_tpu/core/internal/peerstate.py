@@ -18,25 +18,71 @@ This is the ordering-vs-batching resolution from SURVEY.md §7.
 from __future__ import annotations
 
 import asyncio
-from typing import Dict
+import time
+from typing import Dict, Optional
 
 
 class PeerState:
     def __init__(self):
         self._next_cv = 1  # USIG counters start at 1
         self._cond = asyncio.Condition()
+        # cv -> monotonic time its capture started gap-parking.  A
+        # parked capture means a LOWER counter never arrived — on a
+        # faithful transport that only happens around a connection drop
+        # (healed by the redial's HELLO replay), but a lossy/partitioned
+        # link can drop a certified frame while the stream stays up, and
+        # the gap then wedges every later message from this peer FOREVER
+        # (the chaos soak's view-change livelock).  The dial loop
+        # (message_handling.run_peer_connection) watches gap_stalled_for
+        # and forces a redial when a gap persists with no progress.
+        self._parked: Dict[int, float] = {}
+        # Monotonic time the capture sequence last ADVANCED (a capture
+        # applied or a LOG-BASE fast-forward landed).  gap_stalled_for
+        # measures parked time from here, not from when the oldest
+        # capture first parked: a redial's log replay heals a gap by
+        # capturing hundreds of counters in order, and judging the new
+        # stream by the OLD park timestamp would tear it down mid-replay
+        # — before the replay reaches the gap — forever (a redial storm
+        # the chaos soak hit live).
+        self._last_advance = time.monotonic()
 
     async def capture_ui(self, cv: int) -> bool:
         """True once ``cv`` is ours to process (in order); False if ``cv``
         was already captured (duplicate/replayed message)."""
         async with self._cond:
-            while cv > self._next_cv:
-                await self._cond.wait()
+            if cv > self._next_cv:
+                self._parked.setdefault(cv, time.monotonic())
+                try:
+                    while cv > self._next_cv:
+                        await self._cond.wait()
+                finally:
+                    self._parked.pop(cv, None)
             if cv < self._next_cv:
                 return False
             self._next_cv += 1
+            self._last_advance = time.monotonic()
             self._cond.notify_all()
             return True
+
+    def next_expected(self) -> int:
+        """The next UI counter this peer state will capture — everything
+        below it is already captured and applied.  Stamped into the
+        dialer's HELLO as ``resume_counter`` so a redial's log replay
+        skips the captured prefix (plain read: all protocol code runs on
+        one loop, and a stale-low read only costs extra replay)."""
+        return self._next_cv
+
+    def gap_stalled_for(self, now: Optional[float] = None) -> float:
+        """Seconds a capture gap has been parked with NO capture progress
+        at all — 0.0 while nothing is parked OR while captures keep
+        applying (a replay is actively healing the gap).  The redial
+        watchdog keys on this, not on raw parked time (see
+        ``_last_advance``)."""
+        if not self._parked:
+            return 0.0
+        if now is None:
+            now = time.monotonic()
+        return max(0.0, now - max(min(self._parked.values()), self._last_advance))
 
     async def retreat_ui(self, cv: int) -> None:
         """Undo a capture after failed processing (rare; keeps the
@@ -55,6 +101,7 @@ class PeerState:
         async with self._cond:
             if next_cv > self._next_cv:
                 self._next_cv = next_cv
+                self._last_advance = time.monotonic()
             self._cond.notify_all()
 
 

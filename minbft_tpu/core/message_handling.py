@@ -303,7 +303,7 @@ class Handlers:
                 )
 
             self.client_states.client(req.client_id).start_request_timer(
-                timeout, on_expiry
+                req.seq, timeout, on_expiry
             )
 
         def start_prepare_timer(req: Request, view: int) -> None:
@@ -320,16 +320,16 @@ class Handlers:
                 self._unicast_append(primary, req)
 
             self.client_states.client(req.client_id).start_prepare_timer(
-                timeout, on_expiry
+                req.seq, timeout, on_expiry
             )
 
         def stop_timers(req: Request) -> None:
             st = self.client_states.client(req.client_id)
-            st.stop_request_timer()
-            st.stop_prepare_timer()
+            st.stop_request_timer(req.seq)
+            st.stop_prepare_timer(req.seq)
 
         def stop_prepare_timer(req: Request) -> None:
-            self.client_states.client(req.client_id).stop_prepare_timer()
+            self.client_states.client(req.client_id).stop_prepare_timer(req.seq)
 
         # --- request pipeline
         raw_validate_request = request_mod.make_request_validator(verify_signature)
@@ -511,6 +511,11 @@ class Handlers:
                 # view change): counting it would diverge the execution
                 # count — and so the checkpoint sequence — across replicas
                 # that did/didn't execute it pre-transition.
+                self.log.info(
+                    "skipping already-retired request client %d seq %d",
+                    req.client_id,
+                    req.seq,
+                )
                 return
             self.metrics.observe_execute(time.monotonic() - t0)
             self.metrics.inc("requests_executed")
@@ -1451,10 +1456,15 @@ class Handlers:
             self.view_change_state.prune_through(nv.new_view)
             self.commitment_collector.prune_view_bases(nv.new_view)
             self.metrics.inc("view_changes_completed")
+            reproposal_ids = [
+                [seq for _, seq in viewchange_mod.batch_key(p)]
+                for p in s_prepares
+            ]
             self.log.info(
-                "entered view %d (%d re-proposals)",
+                "entered view %d (%d re-proposals: %s)",
                 nv.new_view,
                 len(s_prepares),
+                reproposal_ids,
             )
             if utils.is_primary(nv.new_view, self.replica_id, self.n):
                 for p in s_prepares:
@@ -1809,12 +1819,24 @@ class PeerStreamHandler(api.MessageStreamHandler):
         queue: asyncio.Queue = asyncio.Queue()
         done = asyncio.Event()
 
-        async def pump(log: MessageLog) -> None:
+        async def pump(log: MessageLog, resume: int = 0) -> None:
             async for msg in log.stream(done):
+                if resume:
+                    # Resumable replay: the subscriber has already
+                    # captured every certified counter below ``resume``
+                    # — skip those entries instead of shipping them
+                    # through a possibly-lossy link just to be dedup'd
+                    # at capture.  Non-certified kinds (CHECKPOINT,
+                    # REQ-VIEW-CHANGE, LOG-BASE heads) always replay:
+                    # they are few (the log truncates at checkpoints)
+                    # and dedup receiver-side.
+                    ui = getattr(msg, "ui", None)
+                    if ui is not None and ui.counter < resume:
+                        continue
                 await queue.put(msg)
 
         loop = asyncio.get_running_loop()
-        tasks = [loop.create_task(pump(h.message_log))]
+        tasks = [loop.create_task(pump(h.message_log, hello.resume_counter))]
         ulog = h.unicast_logs.get(peer_id)
         if ulog is not None:
             tasks.append(loop.create_task(pump(ulog)))
@@ -1991,7 +2013,17 @@ async def run_peer_connection(
     the connection down permanently (a local bug would loop forever)."""
 
     async def outgoing() -> AsyncIterator[bytes]:
-        hello = Hello(replica_id=handlers.replica_id)
+        # Resumable replay: everything below next_expected() is already
+        # captured, so tell the publisher to skip it.  Stamped at dial
+        # time (the generator body runs on first iteration), so every
+        # redial resumes from the CURRENT capture frontier — through a
+        # lossy link this heals a counter gap with one short tail replay
+        # instead of re-traversing the whole log (which re-gaps with
+        # probability 1-(1-p)^N, the chaos soak's redial storm).
+        hello = Hello(
+            replica_id=handlers.replica_id,
+            resume_counter=peer_state.next_expected(),
+        )
         handlers.sign_message(hello)
         yield marshal(hello)
         # Keep the stream open until shutdown.
@@ -2020,18 +2052,113 @@ async def run_peer_connection(
         # connection down; sporadic transients never accumulate.
         internal["consecutive"] = 0
 
+    # Capture-gap watchdog: a certified message lost on a LIVE stream (a
+    # lossy or partitioned link — a faithful transport only loses frames
+    # by dropping the connection) leaves this peer's counter sequence
+    # gapped, parking every later message forever; only a redial's HELLO
+    # replay can redeliver the missing counter.  When a gap sits parked
+    # with NO capture progress (gap_stalled_for — progress resets the
+    # clock, so a long replay actively healing the gap is never torn
+    # down) past the bound, AND the current stream has had a full bound
+    # of its own to deliver (a fresh redial inherits parked captures
+    # from the last stream's drain — judging it by their age would kill
+    # every replay mid-flight, a redial storm), the dialer tears its own
+    # stream down and lets the normal redial loop heal the gap.  The
+    # bound rides the view-change timeout (the gap's worst casualty is
+    # the VIEW-CHANGE quorum the transition is waiting on) with a floor
+    # well above any healthy capture reorder.
+    vc_t = getattr(handlers, "_viewchange_timeout", 8.0)
+    gap_redial_s = max(1.0, min(vc_t if vc_t > 0 else 8.0, 8.0))
+    # Idle-refresh watchdog: a lossy link can drop the TAIL of a burst —
+    # a NEW-VIEW with no follow-on traffic leaves no counter gap to park
+    # on, no frame to time out, nothing: the subscriber just sits in the
+    # old view forever (the chaos soak's silent-wedge signature).  The
+    # only cure is asking the publisher again, so a stream that has
+    # delivered NOTHING for a full idle window is torn down and redialed
+    # immediately (no redial-ladder backoff — a refresh, not a failure).
+    # Resumable HELLO replay makes the refresh nearly free: an
+    # up-to-date subscriber replays an empty tail.  The WINDOW itself
+    # backs off, though: on a genuinely quiescent cluster every refresh
+    # finds nothing (the stream only ever delivered the dial-time replay
+    # burst), and a fixed window would churn teardown+HELLO handshakes
+    # forever — consecutive find-nothing refreshes double the window up
+    # to 8x, and a stream that keeps delivering past its replay burst
+    # (real traffic) resets it, so the next silent-tail loss under load
+    # still heals within the base window.
+    idle_redial_base_s = max(2.0 * gap_redial_s, 3.0)
+    idle_redial_s = idle_redial_base_s
+    peer_state = handlers.peer_states.peer(peer_id)
+
     backoff = ReconnectBackoff()
     while not done.is_set():
         proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop, _ok)
         attempt_start = time.monotonic()
+        last_rx = attempt_start
+        idle_refresh = False
         cancelled = False
         # Per-STREAM counter (see _MAX_CONSECUTIVE_INTERNAL_ERRORS): errors
         # accumulated across redials must not add up to a permanent
         # teardown — that would rebuild the silent link-halving wedge
         # reconnection exists to prevent.
         internal["consecutive"] = 0
+        stream = stream_handler.handle_message_stream(outgoing())
+        ait = stream.__aiter__()
+        nxt: Optional[asyncio.Future] = None
+
+        def _gap_wedged() -> bool:
+            return (
+                time.monotonic() - attempt_start > gap_redial_s
+                and peer_state.gap_stalled_for() > gap_redial_s
+            )
+
         try:
-            async for data in stream_handler.handle_message_stream(outgoing()):
+            while True:
+                # Race the next frame against the gap watchdog so a
+                # quiet-but-gapped stream still redials.
+                nxt = asyncio.ensure_future(ait.__anext__())
+                gap_redial = False
+                while not nxt.done():
+                    await asyncio.wait({nxt}, timeout=min(gap_redial_s / 2, 1.0))
+                    if nxt.done():
+                        break
+                    if _gap_wedged():
+                        gap_redial = True
+                        break
+                    if time.monotonic() - last_rx > idle_redial_s:
+                        idle_refresh = True
+                        break
+                if idle_refresh:
+                    handlers.metrics.inc("idle_redials")
+                    handlers.log.info(
+                        "peer %d stream idle > %.1fs: refreshing (resumable "
+                        "replay)",
+                        peer_id,
+                        idle_redial_s,
+                    )
+                    # Replay-burst frames land within ~a gap bound of the
+                    # dial; deliveries past that mark real traffic.
+                    if last_rx - attempt_start > gap_redial_s:
+                        idle_redial_s = idle_redial_base_s
+                    else:
+                        idle_redial_s = min(
+                            idle_redial_s * 2.0, 8.0 * idle_redial_base_s
+                        )
+                    break
+                if gap_redial:
+                    handlers.metrics.inc("gap_redials")
+                    handlers.log.warning(
+                        "peer %d capture gap stalled > %.1fs: redialing for "
+                        "log replay",
+                        peer_id,
+                        gap_redial_s,
+                    )
+                    break
+                try:
+                    data = nxt.result()
+                except StopAsyncIteration:
+                    break
+                nxt = None
+                last_rx = time.monotonic()
                 if done.is_set():
                     break
                 if internal["consecutive"] >= _MAX_CONSECUTIVE_INTERNAL_ERRORS:
@@ -2049,12 +2176,53 @@ async def run_peer_connection(
                     continue
                 for fr in frames:
                     await proc.submit(fr)
+                if _gap_wedged():
+                    handlers.metrics.inc("gap_redials")
+                    handlers.log.warning(
+                        "peer %d capture gap stalled > %.1fs: redialing for "
+                        "log replay",
+                        peer_id,
+                        gap_redial_s,
+                    )
+                    break
         except asyncio.CancelledError:
             cancelled = True
             raise
         except Exception:
             handlers.log.exception("peer %d connection failed", peer_id)
         finally:
+            if nxt is not None:
+                if nxt.done():
+                    try:
+                        nxt.exception()  # retrieve, or asyncio logs it
+                    except asyncio.CancelledError:
+                        pass
+                else:
+                    # cancel() can lose the race against the asend
+                    # completing (StopAsyncIteration on a stream that
+                    # just ended) — retrieve whatever lands so asyncio
+                    # never logs "exception was never retrieved".
+                    nxt.cancel()
+                    nxt.add_done_callback(
+                        lambda t: t.cancelled() or t.exception()
+                    )
+            # Close the manually-iterated stream so the handler's own
+            # finally (pump teardown) runs now, not at GC.  Transport
+            # teardown errors are noise here, but a CANCELLATION landing
+            # while suspended in aclose must propagate — swallowing it
+            # would return this supposedly-cancelled task to the redial
+            # loop and stall the stop() awaiting it.
+            aclose_cancel = False
+            try:
+                await ait.aclose()
+            except asyncio.CancelledError:
+                # Finish the teardown first (proc.cancel below rides the
+                # `cancelled` flag), then re-raise at the end of this
+                # finally so the cancellation wins.
+                cancelled = True
+                aclose_cancel = True
+            except Exception:
+                pass
             # Lived time is the STREAM's lifetime: measured before the
             # drain, which can add up to 30s a crash-looping peer never
             # earned toward the ladder's lived-connection reset.
@@ -2070,8 +2238,19 @@ async def run_peer_connection(
             if cancelled or done.is_set():
                 proc.cancel()
             else:
+                # The drain bound tracks the view-change timeout instead
+                # of a flat 30s: chaos soaks (tests/test_chaos.py) showed
+                # that after a lossy stream dies, the tasks still in
+                # flight are mostly parked PRE-capture on a counter gap a
+                # dropped certified message left — work that can only
+                # complete once the redial's HELLO replay redelivers the
+                # gap, so a long drain delays the very recovery it is
+                # waiting for.  Genuine mid-apply work still gets a
+                # multiple of the cluster's own patience knob.
+                vc = getattr(handlers, "_viewchange_timeout", 8.0)
+                drain_s = min(30.0, max(1.0, 2.0 * vc)) if vc > 0 else 1.0
                 try:
-                    await asyncio.wait_for(asyncio.shield(proc.drain()), 30.0)
+                    await asyncio.wait_for(asyncio.shield(proc.drain()), drain_s)
                 except asyncio.TimeoutError:
                     pass
                 except asyncio.CancelledError:
@@ -2080,8 +2259,15 @@ async def run_peer_connection(
                     proc.cancel()
                     raise
                 proc.cancel()
+            if aclose_cancel:
+                raise asyncio.CancelledError()
         if done.is_set():
             return
+        if idle_refresh:
+            # A refresh is not a failure: redial immediately and leave
+            # the ladder alone (its pace is bounded by idle_redial_s, so
+            # skipping the backoff cannot storm).
+            continue
         delay = backoff.next_delay(lived)
         handlers.metrics.inc("peer_reconnects")
         handlers.log.warning(

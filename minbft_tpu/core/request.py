@@ -114,15 +114,19 @@ def make_request_executor(
 
     BUFFERING stays in execution order even though SIGNING is concurrent:
     each spawned task waits for its PER-CLIENT predecessor before
-    ``add_reply``.  ClientState.add_reply drops a lower seq arriving
-    after a higher one as a stale retry, so two concurrently in-flight
-    sign batches resolving out of order would otherwise permanently lose
-    the earlier REPLY (the client could never assemble its quorum for
-    that seq).  The chain is keyed by client_id — the stale-drop is a
-    per-client rule, and a global chain would let one hung sign batch
-    (90s dispatch timeout) delay every OTHER client's already-signed
-    replies.  It costs nothing in batching — every sign is already
-    submitted to the queue before any completion is awaited.
+    ``add_reply``.  ClientState.add_reply accepts out-of-order seqs (a
+    reordering network legitimately executes a higher seq first under
+    exact retirement), but its reply WINDOW is bounded: if sign batches
+    resolved out of order and more than a window's worth of later
+    replies buffered first, the window floor would pass the earlier seq
+    and its reply would be dropped as pruned — permanently, since a
+    retransmitted REQUEST dedups at retire_seq and can only re-serve a
+    buffered reply.  Ordered buffering closes that window-overflow loss
+    entirely.  The chain is keyed by client_id — the window is a
+    per-client structure, and a global chain would let one hung sign
+    batch (90s dispatch timeout) delay every OTHER client's
+    already-signed replies.  It costs nothing in batching — every sign
+    is already submitted to the queue before any completion is awaited.
 
     ``sign_message_sync`` is the serial emergency signer: if the batch
     path fails (engine dispatch exception), the reply is re-signed

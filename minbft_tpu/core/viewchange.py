@@ -97,7 +97,31 @@ def compute_new_view_set(
     the anchor has f+1 commitments at a batch above it — evidence the
     coverage-bound audit guarantees survives in the quorum logs.  Stubs
     are skipped for the same reason: a stub is validated as covered by
-    its sender's certificate, which the anchor dominates."""
+    its sender's certificate, which the anchor dominates.
+
+    A batch appearing at several slots — its original PREPARE plus its
+    re-proposals from intermediate failed views — is kept ONCE.  Without
+    dedup, every unconcluded view change doubles S (the view-v originals
+    plus the view-v' re-proposals of the same batches), so under
+    sustained faults each transition carries exponentially more work
+    than the last and the cluster livelocks in view-change thrash (the
+    chaos soak found this at "768 re-proposals" of 6 requests).
+
+    The surviving slot is the batch's LATEST (view, counter) appearance.
+    Within any view, re-proposals are certified before fresh proposals
+    IN S ORDER (enforced by check_reproposal at every backup), so the
+    newest view's slots embed the full previously-committed order — the
+    latest slot is always consistent with the execution order of every
+    correct replica.  The earliest slot is NOT: a deposed primary that
+    was stalled through its own view change (half-open link, partition)
+    keeps certifying fresh PREPAREs for client retransmissions at its
+    OLD view, and those uncommittable slots — present only in its own
+    log, sorted before every later view — would steer S into an order
+    that contradicts what the live quorum already executed (the chaos
+    soak hit this as a real ledger fork: the healed ex-primary executed
+    phase-D requests before the phase-C requests the cluster committed
+    first).  A stale primary's late certifications always carry an old
+    VIEW, so latest-slot ordering is immune to them by construction."""
     _, av, acv, _ = quorum_anchor(view_changes)
     prepares: Dict[Tuple[int, int], Prepare] = {}
     for vc in view_changes:
@@ -112,7 +136,11 @@ def compute_new_view_set(
             if cand.is_stub or (cand.view, cand.ui.counter) <= (av, acv):
                 continue
             prepares[(cand.view, cand.ui.counter)] = cand
-    return [prepares[k] for k in sorted(prepares)]
+    # Latest slot per batch: ascending slot iteration, later overwrites.
+    best: Dict[BatchKey, Tuple[Tuple[int, int], Prepare]] = {}
+    for slot in sorted(prepares):
+        best[batch_key(prepares[slot])] = (slot, prepares[slot])
+    return [p for _, p in sorted(best.values(), key=lambda sp: sp[0])]
 
 
 class ViewChangeState:

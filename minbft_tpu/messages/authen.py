@@ -156,7 +156,7 @@ def _authen_bytes(m: Message) -> bytes:
             + h.digest()
         )
     if isinstance(m, Hello):
-        return b"HELLO" + _U32.pack(m.replica_id)
+        return b"HELLO" + _U32.pack(m.replica_id) + _U64.pack(m.resume_counter)
     if isinstance(m, SnapshotReq):
         return b"SNAPSHOT-REQ" + _U32.pack(m.replica_id) + _U64.pack(m.count)
     if isinstance(m, SnapshotResp):

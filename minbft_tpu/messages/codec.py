@@ -146,6 +146,7 @@ def marshal(m: Message) -> bytes:
         return (
             bytes([_TAG_HELLO])
             + _pack_u32(m.replica_id)
+            + _pack_u64(m.resume_counter)
             + _pack_bytes(m.signature)
         )
     if isinstance(m, Request):
@@ -327,8 +328,9 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
     off += 1
     if tag == _TAG_HELLO:
         rid, off = _read_u32(data, off)
+        resume, off = _read_u64(data, off)
         sig, off = _read_bytes(data, off)
-        return Hello(replica_id=rid, signature=sig), off
+        return Hello(replica_id=rid, signature=sig, resume_counter=resume), off
     if tag == _TAG_REQUEST:
         cid, off = _read_u32(data, off)
         seq, off = _read_u64(data, off)

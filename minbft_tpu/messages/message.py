@@ -72,11 +72,28 @@ class Hello(Message):
     the unicast-log CONTENT invariant pinned at
     ``UNICAST_LOG_MESSAGES`` below: read that note before adding any
     kind to a unicast log.
+
+    ``resume_counter`` makes the replay RESUMABLE: the dialer stamps the
+    next UI counter it expects from this peer (everything below it is
+    already captured), and the publisher skips certified log entries
+    with lower counters.  Through a lossy link this is the difference
+    between healing a gap and a redial storm — a full replay must
+    traverse the whole retained log intact to reach the gap counter
+    (success probability ``(1-p)^N``), a resumed one only the missed
+    tail.  Signed along with the id, so an in-path attacker cannot
+    inflate it to starve the subscriber of entries it still needs.  A
+    replayed old HELLO carries a STALE (lower) resume point — more
+    replay, still harmless; ``0`` (the default) means replay everything.
+    The wire format is NOT backward compatible (the u64 sits between
+    replica_id and the signature, and both codec and authen-bytes
+    include it) — all peers of a cluster run the same build, as
+    everywhere else in this codec.
     """
 
     KIND = "HELLO"
     replica_id: int
     signature: bytes = b""
+    resume_counter: int = 0
 
 
 @dataclasses.dataclass
@@ -371,7 +388,9 @@ class SnapshotResp(Message):
     view: int
     cv: int
     app_state: bytes
-    watermarks: Tuple[Tuple[int, int], ...] = ()  # sorted (client, retired)
+    # Sorted (client, seq) pairs; per client: retire floor first, then
+    # the individually retired seqs above it (clientstate.retire_watermarks).
+    watermarks: Tuple[Tuple[int, int], ...] = ()
     cert: Tuple[Checkpoint, ...] = ()
     signature: bytes = b""
 

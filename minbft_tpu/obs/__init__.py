@@ -19,7 +19,13 @@ protocol pays one predicated attribute check per hook.
 """
 
 from .hist import Log2Histogram
-from .prom import MetricsServer, collect_replica, render_families, scrape
+from .prom import (
+    MetricsServer,
+    collect_faultnet,
+    collect_replica,
+    render_families,
+    scrape,
+)
 from .trace import (
     CLIENT_STAGES,
     REPLICA_STAGES,
@@ -40,6 +46,7 @@ __all__ = [
     "MTStageRing",
     "MetricsServer",
     "StageRing",
+    "collect_faultnet",
     "collect_replica",
     "dump_recorder",
     "load_dumps",

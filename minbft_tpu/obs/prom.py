@@ -158,6 +158,56 @@ def collect_replica(
     return fams
 
 
+def collect_faultnet(census, base: Optional[Dict[str, str]] = None) -> List[Family]:
+    """Metric families for a fault-injection census
+    (:class:`minbft_tpu.testing.faultnet.FaultCensus`, duck-typed:
+    ``counters`` per-kind totals, ``links`` per-(src,dst) kind maps,
+    ``frames`` per-link frame counts).  Lets a chaos run's fault census
+    ride the same Prometheus endpoint as the protocol counters — the
+    injected-fault ground truth next to the recovery metrics it caused.
+    """
+    base = dict(base or {})
+    fams: List[Family] = []
+    totals = [
+        ({**base, "kind": kind}, v)
+        for kind, v in sorted(dict(census.counters).items())
+    ]
+    fams.append(
+        (
+            "minbft_faultnet_injected_total",
+            "counter",
+            "faults injected by kind (faultnet census)",
+            totals,
+        )
+    )
+    per_link = []
+    for (src, dst), kinds in sorted(dict(census.links).items()):
+        for kind, v in sorted(dict(kinds).items()):
+            per_link.append(
+                ({**base, "link": f"{src}>{dst}", "kind": kind}, v)
+            )
+    fams.append(
+        (
+            "minbft_faultnet_link_injected_total",
+            "counter",
+            "faults injected per directed link and kind",
+            per_link,
+        )
+    )
+    fams.append(
+        (
+            "minbft_faultnet_frames_total",
+            "counter",
+            "frames that traversed each directed link (replay input)",
+            [
+                ({**base, "link": f"{src}>{dst}"}, v)
+                for (src, dst), v in sorted(dict(census.frames).items())
+            ],
+        )
+    )
+    return fams
+
+
 def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
     fams: List[Family] = []
     for side, stats_map, depths in (

@@ -34,6 +34,15 @@ class ReplicaStub(api.ConnectionHandler):
         view-change tests to take the primary down for real."""
         self._crashed.set()
 
+    def revive(self) -> None:
+        """Undo :meth:`crash` for NEW streams: the restart half of
+        crash/restart fault injection (testing/faultnet.py).  Streams
+        opened before the crash stay dead (they raced the old event);
+        fresh dials reach whatever replica is (re-)assigned — callers
+        restart a replica by ``assign_replica``-ing a new instance (or an
+        adversarial stand-in) and then reviving."""
+        self._crashed = asyncio.Event()
+
     def peer_message_stream_handler(self) -> api.MessageStreamHandler:
         return _DeferredHandler(self, "peer")
 

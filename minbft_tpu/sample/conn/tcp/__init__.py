@@ -70,11 +70,19 @@ class _TcpStreamHandler(api.MessageStreamHandler):
     """Dial side of one chat stream (one TCP connection per stream —
     mirrors gRPC's one-RPC-per-handle_message_stream shape)."""
 
-    def __init__(self, host: str, port: int, kind: bytes, dial_timeout: float):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        kind: bytes,
+        dial_timeout: float,
+        idle_timeout: float = 0.0,
+    ):
         self._host = host
         self._port = port
         self._kind = kind
         self._dial_timeout = dial_timeout
+        self._idle_timeout = idle_timeout
 
     async def _connect(self):
         # wait_for_ready semantics (reference grpc.WaitForReady(true)):
@@ -108,7 +116,27 @@ class _TcpStreamHandler(api.MessageStreamHandler):
         pump = asyncio.get_running_loop().create_task(pump_out())
         try:
             while True:
-                frame = await _read_frame(reader)
+                if self._idle_timeout > 0:
+                    # Read-idle detection for HALF-OPEN peers: a stalled
+                    # link (peer process wedged, or a middlebox silently
+                    # dropping the flow) keeps the TCP connection "up"
+                    # while frames stop — without a deadline this read
+                    # parks forever and the ReconnectBackoff redial loop
+                    # above never gets its turn.  Ending the stream here
+                    # IS the recovery: the caller tears down and redials,
+                    # and the peer's HELLO replay restores the log.  The
+                    # broadcast-log stream is never legitimately idle for
+                    # long (checkpoints and retransmissions keep flowing),
+                    # so operators size this in seconds, well above any
+                    # healthy gap; 0 (default) disables.
+                    try:
+                        frame = await asyncio.wait_for(
+                            _read_frame(reader), self._idle_timeout
+                        )
+                    except asyncio.TimeoutError:
+                        return
+                else:
+                    frame = await _read_frame(reader)
                 if frame is None:
                     return
                 yield frame
@@ -120,11 +148,20 @@ class _TcpStreamHandler(api.MessageStreamHandler):
 class TcpReplicaConnector(api.ReplicaConnector):
     """Dial-side connector over raw TCP (gRPC-connector contract)."""
 
-    def __init__(self, kind: str = "peer", dial_timeout: float = 120.0):
+    def __init__(
+        self,
+        kind: str = "peer",
+        dial_timeout: float = 120.0,
+        idle_timeout: float = 0.0,
+    ):
         if kind not in ("peer", "client"):
             raise ValueError(f"unknown chat kind {kind!r}")
         self._kind = PEER_KIND if kind == "peer" else CLIENT_KIND
         self._dial_timeout = dial_timeout
+        # Per-stream read-idle deadline (seconds; 0 = off): tears down a
+        # half-open connection so the redial loop can recover it — see
+        # _TcpStreamHandler.handle_message_stream.
+        self._idle_timeout = idle_timeout
         self._targets: Dict[int, tuple] = {}
 
     def connect_replica(self, replica_id: int, target: str) -> None:
@@ -137,7 +174,9 @@ class TcpReplicaConnector(api.ReplicaConnector):
         t = self._targets.get(replica_id)
         if t is None:
             return None
-        return _TcpStreamHandler(t[0], t[1], self._kind, self._dial_timeout)
+        return _TcpStreamHandler(
+            t[0], t[1], self._kind, self._dial_timeout, self._idle_timeout
+        )
 
     async def close(self) -> None:
         # Connections are per-stream and owned by their handlers; nothing
@@ -146,9 +185,9 @@ class TcpReplicaConnector(api.ReplicaConnector):
 
 
 def connect_many_replicas_tcp(
-    targets: Dict[int, str], kind: str = "peer"
+    targets: Dict[int, str], kind: str = "peer", idle_timeout: float = 0.0
 ) -> TcpReplicaConnector:
-    conn = TcpReplicaConnector(kind)
+    conn = TcpReplicaConnector(kind, idle_timeout=idle_timeout)
     for rid, target in targets.items():
         conn.connect_replica(rid, target)
     return conn

@@ -209,6 +209,17 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         help="bind address for --metrics-port (default loopback — the "
         "endpoint is unauthenticated; widen deliberately)",
     )
+    r.add_argument(
+        "--peer-idle-timeout",
+        type=float,
+        default=_opt("peer_idle_timeout", 0.0, section="run"),
+        help="TCP transport only: tear down a peer stream that delivers "
+        "no frame for N seconds (a half-open link — machine wedged, NIC "
+        "dead, but the socket still 'open'), so the redial loop can "
+        "recover it; 0 = off (default).  Size it well above the "
+        "checkpoint/view-change cadence — a healthy broadcast-log "
+        "stream is never legitimately idle for long.",
+    )
 
     m = sub.add_parser(
         "metrics",
@@ -263,7 +274,23 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         "--tag", default="", help="payload tag (keeps concurrent procs' ops distinct)"
     )
 
-    sub.add_parser("selftest", help="in-process n=4 cluster smoke test")
+    st = sub.add_parser("selftest", help="in-process n=4 cluster smoke test")
+    st.add_argument(
+        "--chaos-seed",
+        type=lambda s: int(s, 0),
+        default=None,
+        metavar="SEED",
+        help="run the smoke workload through a seeded fault-injection "
+        "network (testing/faultnet.py); MINBFT_CHAOS_SEED overrides, "
+        "omitted = fresh random seed (printed for replay)",
+    )
+    st.add_argument(
+        "--chaos-profile",
+        choices=("lossy", "flaky", "slow"),
+        default=None,
+        help="fault plan applied to every link (default with --chaos-seed: "
+        "lossy); implies chaos mode",
+    )
 
     t = sub.add_parser(
         "testnet", help="scaffold keys.yaml + consensus.yaml for a local cluster"
@@ -353,7 +380,14 @@ async def _run_replica(args) -> int:
         auth = store.replica_authenticator(
             args.id, engine=engine, batch_signatures=batch_signatures
         )
-    conn = GrpcReplicaConnector("peer")
+    if args.transport == "tcp":
+        # Half-open peer detection (read-idle teardown) is a property of
+        # the native framing only; gRPC manages its own channel health.
+        conn = GrpcReplicaConnector(
+            "peer", idle_timeout=args.peer_idle_timeout
+        )
+    else:
+        conn = GrpcReplicaConnector("peer")
     for rid, addr in addrs.items():
         if rid != args.id:
             conn.connect_replica(rid, addr)
@@ -651,6 +685,33 @@ async def _run_selftest(args) -> int:
     store = generate_testnet_keys(n, n_clients=1)
     cfg = SimpleConfiger(n=n, f=f, timeout_request=60.0, timeout_prepare=30.0)
     stubs = make_testnet_stubs(n)
+
+    # Chaos mode: the same smoke workload, but every link flows through a
+    # seeded fault-injection network — the CLI face of tests/test_chaos.py
+    # (deterministic replay via the printed seed / MINBFT_CHAOS_SEED).
+    net = None
+    if args.chaos_seed is not None or args.chaos_profile is not None:
+        from ...testing import PROFILES, FaultNet, chaos_seed
+
+        # The chaos seed is a PUBLIC replay token (printed so a failed
+        # run can be reproduced) — identifiers carry the "chaos" word
+        # so the secret-hygiene pass knows it is not key material.
+        run_chaos_seed = chaos_seed(args.chaos_seed)
+        profile = args.chaos_profile or "lossy"
+        net = FaultNet(seed=run_chaos_seed, default_plan=PROFILES[profile])
+        cfg = SimpleConfiger(
+            n=n, f=f, timeout_request=2.0, timeout_prepare=1.0,
+            timeout_viewchange=4.0,
+        )
+        print(
+            f"chaos selftest: profile={profile} seed={run_chaos_seed:#x} "
+            f"(replay: MINBFT_CHAOS_SEED={run_chaos_seed:#x})",
+            file=sys.stderr,
+        )
+
+    def _wrap(conn, endpoint):
+        return net.wrap(conn, endpoint) if net is not None else conn
+
     ledgers = [SimpleLedger() for _ in range(n)]
     replicas = []
     for i in range(n):
@@ -658,7 +719,7 @@ async def _run_selftest(args) -> int:
             i,
             cfg,
             store.replica_authenticator(i),
-            InProcessPeerConnector(stubs),
+            _wrap(InProcessPeerConnector(stubs), f"r{i}"),
             ledgers[i],
             opts=_log_opts(args),
         )
@@ -667,9 +728,73 @@ async def _run_selftest(args) -> int:
     for r in replicas:
         await r.start()
     client = new_client(
-        0, n, f, store.client_authenticator(0), InProcessClientConnector(stubs)
+        0,
+        n,
+        f,
+        store.client_authenticator(0),
+        _wrap(InProcessClientConnector(stubs), "c0"),
+        retransmit_interval=1.0 if net is not None else None,
     )
     await client.start()
+
+    if net is not None:
+        # The smoke request plus a short seeded soak: more ordered
+        # traffic, then the cross-replica safety invariants.  The strict
+        # fast-read check below is skipped — under a lossy plan the
+        # no-fallback fast quorum is legitimately unavailable.  A
+        # TimeoutError here is the chaos run's MOST LIKELY failure mode
+        # (a wedged cluster) — it must fall through to the designed
+        # report (census + replay seed + clean teardown), not escape as
+        # a raw traceback that skips all three.
+        from ...testing import InvariantChecker
+
+        accepted = []
+        ok = True
+        try:
+            result = await asyncio.wait_for(client.request(b"selftest"), 60)
+            accepted.append((b"selftest", result))
+            ops = [b"chaos-%d" % i for i in range(5)]
+            results = await asyncio.wait_for(
+                asyncio.gather(
+                    *[client.request(op, timeout=90) for op in ops]
+                ),
+                120,
+            )
+            accepted.extend(zip(ops, results))
+        except asyncio.TimeoutError:
+            print("selftest: chaos workload wedged past its deadline",
+                  file=sys.stderr)
+            ok = False
+        want = len(accepted)
+        if ok:
+            for _ in range(600):
+                if all(lg.length >= want for lg in ledgers):
+                    break
+                await asyncio.sleep(0.05)
+            ok = all(lg.length >= want for lg in ledgers)
+        if ok:
+            try:
+                InvariantChecker(replicas, ledgers).check(accepted)
+            except AssertionError as e:
+                print(f"selftest FAILED: invariant violation: {e}",
+                      file=sys.stderr)
+                ok = False
+        await client.stop()
+        for r in replicas:
+            await r.stop()
+        census = net.census.snapshot()
+        print(f"chaos census: {census['counters']} "
+              f"({census['frames_total']} frames)", file=sys.stderr)
+        if not ok:
+            print("selftest FAILED: chaos workload did not commit on all "
+                  f"replicas (replay: MINBFT_CHAOS_SEED={net.chaos_seed:#x})",
+                  file=sys.stderr)
+            return 1
+        print(f"chaos selftest ok: {want} requests committed on all {n} "
+              f"replicas under seed {net.chaos_seed:#x}, invariants green",
+              file=sys.stderr)
+        return 0
+
     result = await asyncio.wait_for(client.request(b"selftest"), 60)
     for _ in range(200):
         if all(lg.length == 1 for lg in ledgers):

@@ -1,0 +1,45 @@
+"""Deterministic fault injection, Byzantine adversaries, and safety
+invariants (ISSUE 5).
+
+Three modules, usable from tests AND from the ``peer selftest
+--chaos-seed`` CLI smoke path:
+
+- :mod:`~minbft_tpu.testing.faultnet` — a seeded, replayable
+  fault-injection layer wrapping any :class:`minbft_tpu.api.ReplicaConnector`
+  (in-process, TCP, and gRPC all flow through the same interface): drop,
+  delay, duplicate, reorder, byte-corrupt, stream reset, half-open stall,
+  partition/heal, with a scrapeable fault census;
+- :mod:`~minbft_tpu.testing.adversary` — Byzantine replica harnesses
+  that speak real signed/certified messages through the real codec
+  (equivocation, stale-UI replay, wrong-view PREPARE, counter-gap COMMIT,
+  conflicting REPLYs);
+- :mod:`~minbft_tpu.testing.invariants` — cross-replica safety checks
+  (prefix-consistent execution logs, gap-free monotonic UI sequences,
+  client-accepted results present in every correct ledger), callable
+  mid-run and at teardown.
+"""
+
+from .faultnet import (
+    CHAOS_SEED_ENV,
+    PROFILES,
+    FaultCensus,
+    FaultNet,
+    FaultPlan,
+    FaultyConnectionHandler,
+    FaultyConnector,
+    chaos_seed,
+)
+from .invariants import InvariantChecker, InvariantViolation
+
+__all__ = [
+    "CHAOS_SEED_ENV",
+    "PROFILES",
+    "FaultCensus",
+    "FaultNet",
+    "FaultPlan",
+    "FaultyConnectionHandler",
+    "FaultyConnector",
+    "InvariantChecker",
+    "InvariantViolation",
+    "chaos_seed",
+]

@@ -25,13 +25,16 @@ jax.config.update("jax_platforms", "cpu")
 
 
 async def make_cluster(
-    n=4, f=1, n_clients=1, usig_kind="hmac", cfg=None, **auth_kw
+    n=4, f=1, n_clients=1, usig_kind="hmac", cfg=None, wrap_conn=None,
+    **auth_kw
 ):
     """Start an in-process cluster (the reference integration-test layout,
     core/integration_test.go:212-226).  Returns (replicas, client_auths,
     stubs, ledgers); caller stops the replicas.  Pass ``cfg`` to override
     the default long-timeout SimpleConfiger (e.g. short timeouts for
-    view-change tests)."""
+    view-change tests).  ``wrap_conn(replica_id, connector)`` wraps each
+    replica's peer connector — the chaos tests use it to route every peer
+    link through a testing.faultnet.FaultNet."""
     from minbft_tpu.core import new_replica
     from minbft_tpu.sample.authentication import new_test_authenticators
     from minbft_tpu.sample.config import SimpleConfiger
@@ -50,7 +53,10 @@ async def make_cluster(
     ledgers = [SimpleLedger() for _ in range(n)]
     replicas = []
     for i in range(n):
-        r = new_replica(i, cfg, r_auths[i], InProcessPeerConnector(stubs), ledgers[i])
+        conn = InProcessPeerConnector(stubs)
+        if wrap_conn is not None:
+            conn = wrap_conn(i, conn)
+        r = new_replica(i, cfg, r_auths[i], conn, ledgers[i])
         stubs[i].assign_replica(r)
         replicas.append(r)
     for r in replicas:

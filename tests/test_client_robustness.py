@@ -202,13 +202,65 @@ def test_reconnect_backoff_ladder():
     after a lived connection (a crash-looping peer must not be rewarded)."""
     from minbft_tpu.utils.backoff import ReconnectBackoff
 
-    b = ReconnectBackoff(start_s=0.2, cap_s=10.0, lived_reset_s=5.0)
+    b = ReconnectBackoff(start_s=0.2, cap_s=10.0, lived_reset_s=5.0,
+                         jitter_frac=0.0)
     assert [b.next_delay(0.0) for _ in range(7)] == [
         0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 10.0,
     ]
     assert b.next_delay(0.0) == 10.0  # pinned at the cap
     assert b.next_delay(6.0) == 0.2   # lived >5s: ladder restarts
     assert b.next_delay(0.1) == 0.4
+
+
+def test_reconnect_backoff_default_jitter_desynchronizes():
+    """Two ladders born in the same tick (a partition heal ends every
+    stream at once) must NOT redial in lockstep: the default jitter makes
+    their delay sequences diverge while staying in the +-25% envelope."""
+    import random
+
+    from minbft_tpu.utils.backoff import ReconnectBackoff
+
+    a = ReconnectBackoff(rng=random.Random(1))
+    b = ReconnectBackoff(rng=random.Random(2))
+    da = [a.next_delay(0.0) for _ in range(6)]
+    db = [b.next_delay(0.0) for _ in range(6)]
+    assert da != db
+    ladder = 0.2
+    for x, y in zip(da, db):
+        for d in (x, y):
+            assert ladder * 0.75 - 1e-9 <= d <= min(ladder * 1.25, 10.0) + 1e-9
+        ladder = min(ladder * 2.0, 10.0)
+
+
+def test_retransmit_backoff_ladder():
+    """Client retransmit policy: capped exponential with jitter — the
+    un-jittered ladder doubles from start to the 8x default cap, jittered
+    delays stay in the envelope, and start_s must be positive."""
+    import random
+
+    import pytest
+
+    from minbft_tpu.utils.backoff import RetransmitBackoff
+
+    b = RetransmitBackoff(0.1, jitter_frac=0.0)
+    assert [round(b.next_delay(), 10) for _ in range(6)] == [
+        0.1, 0.2, 0.4, 0.8, 0.8, 0.8,
+    ]
+    b2 = RetransmitBackoff(0.1, cap_s=0.3, jitter_frac=0.0)
+    assert [round(b2.next_delay(), 10) for _ in range(4)] == [
+        0.1, 0.2, 0.3, 0.3,
+    ]
+    jb = RetransmitBackoff(0.1, jitter_frac=0.25, rng=random.Random(7))
+    ladder = 0.1
+    seen_off_ladder = False
+    for _ in range(8):
+        d = jb.next_delay()
+        assert ladder * 0.75 - 1e-9 <= d <= min(ladder * 1.25, 0.8) + 1e-9
+        seen_off_ladder = seen_off_ladder or abs(d - ladder) > 1e-9
+        ladder = min(ladder * 2.0, 0.8)
+    assert seen_off_ladder  # jitter actually moved the delays
+    with pytest.raises(ValueError):
+        RetransmitBackoff(0.0)
 
 
 class _ChaosClientConnector(api.ReplicaConnector):

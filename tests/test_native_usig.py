@@ -18,8 +18,13 @@ NATIVE_DIR = os.path.join(
     os.path.dirname(__file__), "..", "minbft_tpu", "native"
 )
 
+# Gate on actual LOADABILITY, not just a successful `make`: a container
+# without libcrypto.so.3 (or with a stale artifact from one) can have a
+# libusig.so on disk that cannot link or load — that is "module
+# unavailable" (skip), not a test failure.
 pytestmark = pytest.mark.skipif(
-    not native_mod.build(), reason="native toolchain unavailable"
+    not native_mod.available(auto_build=True),
+    reason="native USIG unavailable (toolchain or libcrypto.so.3 missing)",
 )
 
 

@@ -140,10 +140,26 @@ def test_backoff_ladder_properties():
         start = rng.uniform(0.05, 1.0)
         cap = start * rng.uniform(2.0, 50.0)
         lived = rng.uniform(1.0, 10.0)
-        b = ReconnectBackoff(start_s=start, cap_s=cap, lived_reset_s=lived)
+        b = ReconnectBackoff(
+            start_s=start, cap_s=cap, lived_reset_s=lived, jitter_frac=0.0
+        )
         prev = 0.0
         for _ in range(20):
             d = b.next_delay(0.0)
             assert prev <= d <= cap + 1e-9, (prev, d, cap)
             prev = d
         assert b.next_delay(lived + 0.1) == start
+        # With jitter on, every delay stays inside the +-frac envelope of
+        # the deterministic ladder (and under the cap) — the spread that
+        # de-synchronizes a partition heal's redial herd.
+        j = rng.uniform(0.05, 0.5)
+        jb = ReconnectBackoff(
+            start_s=start, cap_s=cap, lived_reset_s=lived, jitter_frac=j,
+            rng=random.Random(1),
+        )
+        ladder = start
+        for _ in range(20):
+            d = jb.next_delay(0.0)
+            lo, hi = ladder * (1 - j), min(ladder * (1 + j), cap)
+            assert lo - 1e-9 <= d <= hi + 1e-9, (d, lo, hi)
+            ladder = min(ladder * 2.0, cap)

@@ -351,11 +351,17 @@ def test_clientstate_out_of_order_capture_not_dropped():
         # both are now duplicates
         assert not await st.capture_request_seq(89)
         assert not await st.capture_request_seq(73)
-        # execution retires at 89 (watermark jump) — everything at or
-        # below dedups, the done-set is pruned
+        # execution retires 89 EXACTLY — retirement must not jump the
+        # watermark past 80: with pipelined clients a reordered higher
+        # seq commits first, and a jump would silently supersede the
+        # still-live lower request (never executed, never replied — the
+        # chaos soak wedged on this).
         assert st.retire_request_seq(89)
-        assert not await st.capture_request_seq(80)
-        assert st._done == set()
+        assert await st.capture_request_seq(80)  # still live, still captures
+        await st.release_request_seq(80)
+        assert st.retire_request_seq(80)  # executes later, retires exactly
+        assert not st.retire_request_seq(80)  # then dedups
+        assert 89 not in st._done and 80 not in st._done
         # a genuinely new seq still works
         assert await st.capture_request_seq(90)
         await st.release_request_seq(90)

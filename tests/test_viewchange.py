@@ -55,6 +55,60 @@ def test_compute_new_view_set_orders_and_dedups():
     assert vc_mod.compute_new_view_set([vc3], 1) == []
 
 
+def test_compute_new_view_set_collapses_reproposed_batches():
+    """A batch surviving several failed transitions appears in the quorum
+    logs once per view it was (re-)proposed in — under different UIs, so
+    slot dedup alone keeps them all and S doubles per failed view change
+    (the chaos soak livelocked at 768 re-proposals of 6 requests).  The
+    batch must be kept ONCE, at its LATEST (view, counter) slot, with
+    genuinely distinct batches still ordered around it."""
+    orig_a = _prepare(5, view=0, primary=0, reqs=[_req(1, 1)])
+    orig_b = _prepare(6, view=0, primary=0, reqs=[_req(1, 2)])
+    # view 1's primary re-proposed both (new UIs, same batches), then a
+    # fresh batch c was proposed after the re-proposals
+    re_a = _prepare(3, view=1, primary=1, reqs=[_req(1, 1)])
+    re_b = _prepare(4, view=1, primary=1, reqs=[_req(1, 2)])
+    fresh_c = _prepare(5, view=1, primary=1, reqs=[_req(1, 3)])
+    vc1 = ViewChange(
+        replica_id=1, new_view=2, log=(orig_a, orig_b), ui=UI(counter=9)
+    )
+    vc2 = ViewChange(
+        replica_id=2, new_view=2, log=(re_a, re_b, fresh_c), ui=UI(counter=9)
+    )
+    s = vc_mod.compute_new_view_set([vc1, vc2], 2)
+    assert [(p.view, p.ui.counter) for p in s] == [(1, 3), (1, 4), (1, 5)]
+    assert [vc_mod.batch_key(p) for p in s] == [
+        ((1, 1),), ((1, 2),), ((1, 3),)
+    ]
+
+
+def test_compute_new_view_set_ignores_stale_primary_slots():
+    """The chaos-soak ledger fork (ISSUE 5): a deposed primary stalled
+    through its own view change keeps certifying fresh PREPAREs for
+    client retransmissions at its OLD view.  Those slots exist only in
+    its own log and sort before every later view — an earliest-slot
+    dedup would order the late batch BEFORE batches the live quorum
+    committed first, forking the healed replica's ledger.  Latest-slot
+    dedup must order by the genuine (newest-view) slots instead."""
+    # Live history: batch X committed at view 1 slot 3, then batch Y
+    # proposed at view 1 slot 4.
+    live_x = _prepare(3, view=1, primary=1, reqs=[_req(1, 10)])
+    live_y = _prepare(4, view=1, primary=1, reqs=[_req(1, 11)])
+    # The stalled view-0 primary certified Y fresh at its stale view
+    # AFTER the cluster moved on (high own counter, old view).
+    stale_y = _prepare(50, view=0, primary=0, reqs=[_req(1, 11)])
+    vc_live = ViewChange(
+        replica_id=1, new_view=2, log=(live_x, live_y), ui=UI(counter=9)
+    )
+    vc_stale = ViewChange(
+        replica_id=0, new_view=2, log=(stale_y,), ui=UI(counter=51)
+    )
+    s = vc_mod.compute_new_view_set([vc_live, vc_stale], 2)
+    # X before Y — the committed order — not [Y, X] via the stale slot.
+    assert [vc_mod.batch_key(p) for p in s] == [((1, 10),), ((1, 11),)]
+    assert [(p.view, p.ui.counter) for p in s] == [(1, 3), (1, 4)]
+
+
 def test_batch_key_and_reproposal_enforcement():
     st = vc_mod.ViewChangeState(4, 1, replica_id=2)
     a = _prepare(7, view=1, primary=1, reqs=[_req(1, 1), _req(2, 3)])

@@ -128,7 +128,12 @@ class SecretHygieneConfig:
     )
     public_re: str = (
         r"^(pub|public|keyspec|keystore|keytool|id|ids|kid|anchor|anchors|"
-        r"fingerprint|digest|spec|store|error|file|path|len|size|env)$"
+        r"fingerprint|digest|spec|store|error|file|path|len|size|env|"
+        # A chaos-replay seed is a PUBLIC token: the fault-injection
+        # layer prints it on failure so the run can be reproduced
+        # (testing/faultnet.py) — it is an RNG schedule id, not key
+        # material, and identifiers carry the "chaos" word to say so.
+        r"chaos)$"
     )
 
 
@@ -296,6 +301,34 @@ def default_config() -> AnalyzeConfig:
                 cls="FlightRecorder",
                 locks=(),
                 guarded=("_last",),
+            ),
+            # Chaos fault fabric (testing/faultnet.py, ISSUE 5): ONE
+            # FaultNet is shared by every wrapped endpoint's pipes on one
+            # event loop.  Scripted-state flips (stall/partition/reset
+            # epoch/plan swaps) and census bumps are sync methods —
+            # loop-atomic; the async pipe() only READS shared state
+            # between awaits, so a mutation appearing inside a
+            # suspendable method would be exactly the torn-schedule race
+            # this spec exists to catch.
+            LockClassSpec(
+                path="minbft_tpu/testing/faultnet.py",
+                cls="FaultNet",
+                locks=(),
+                guarded=(
+                    "_default_plan",
+                    "_plans",
+                    "_links",
+                    "_stalled",
+                    "_partition",
+                    "_reset_epoch",
+                    "_state_event",
+                ),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/testing/faultnet.py",
+                cls="FaultCensus",
+                locks=(),
+                guarded=("counters", "links", "frames"),
             ),
             # The software USIG's counter is certified-then-incremented
             # under a real threading.Lock (reference ecallLock).
