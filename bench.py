@@ -26,7 +26,7 @@ bench_extras line carries the headline-grade subset):
   {prefix}_stage_{name}_p50_ms / {prefix}_stage_{name}_share
       flight-recorder cost breakdown (minbft_tpu/obs, ISSUE 4), from one
       extra SHORT traced run per trace_run config (the timed runs stay
-      untraced).  Replica stages: recv→verify_enqueue→verify_done→
+      untraced).  Replica stages: ingest→recv→verify_enqueue→verify_done→
       prepare→commit_quorum→execute→reply_sign→reply_sent; client
       stages are client_-prefixed (sign/broadcast/first_reply/quorum).
       Each p50 is "time from the previous capture point to this one"
@@ -42,6 +42,20 @@ bench_extras line carries the headline-grade subset):
       REQUEST/REPLY signing through the sign queue: sign_share is the
       device-signed fraction of queue-routed signatures (USIG UI signing
       is serial by design and never counted here)
+  {prefix}_ingest_batch_mean / {prefix}_ingest_ticks_per_sec
+      bundle-ingest runtime fill gauges, emitted by every e2e config:
+      mean flat frames decoded per ingest tick (summed over replicas)
+      and aggregate ticks/sec.  Both 0 when MINBFT_BUNDLE_INGEST=0
+      (the per-frame-task A/B lever; perf/BATCH_RUNTIME.md).  The
+      per-tick fill DISTRIBUTION is scraped live as the
+      minbft_ingest_bundle_frames log2 histogram (obs/prom.py).
+  ingest_off_* / ingest{8,64,1024}_*   ingest-batch-size sweep
+      (bench_ingest_sweep): the same short n=4 HMAC e2e config per
+      operating point — per-task path, then MINBFT_INGEST_MAX=K — each
+      emitting the full e2e key set under its prefix
+  uvloop   True when MINBFT_UVLOOP (auto-detect) put uvloop behind the
+      bench's event loops — numbers are never silently attributed to
+      the wrong loop
   prep_batch, {scheme}_prep_items_per_sec,
       {scheme}_prep_scalar_items_per_sec, {scheme}_prep_speedup
       host batch-prep microbench: vectorized prepare_batch vs the
@@ -62,7 +76,12 @@ Environment knobs:
                             multi-process phase operating point
   MINBFT_BENCH_SLO_P50_MS   latency target for the *_at_p50_* runs (500)
   MINBFT_BENCH_SKIP_E2E / _SKIP_MP / _SKIP_NODEDUP / _SKIP_SLO /
-  _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519 / _SKIP_RO   phase gates
+  _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519 / _SKIP_RO /
+  _SKIP_INGEST   phase gates
+  MINBFT_BENCH_INGEST_REQUESTS   ingest-sweep run length (400 CPU / 600)
+  MINBFT_BUNDLE_INGEST=0         runtime lever: per-frame-task pumps
+  MINBFT_INGEST_MAX              flat frames per ingest tick (1024)
+  MINBFT_UVLOOP                  event loop: auto|1|0 (utils/loop.py)
   MINBFT_BENCH_RO_READS     read-only phase size (default 4000)
   MINBFT_BENCH_SKIP_PREFLIGHT=1   skip the backend-retry pre-flight
   MINBFT_BENCH_PREFLIGHT_ATTEMPTS backend probes before CPU re-exec (8)
@@ -1176,6 +1195,17 @@ async def _bench_cluster(
         f"{prefix}_clients": n_clients,
         f"{prefix}_requests": n_requests,
         f"{prefix}_committed_req_per_sec": round(n_requests / dt, 1),
+        # Bundle-ingest fill (the batch-runtime's headline gauges): mean
+        # flat frames decoded per ingest tick across every replica, and
+        # the aggregate tick rate.  Both 0 when MINBFT_BUNDLE_INGEST=0
+        # (the per-task A/B lever) — the keys are always present so the
+        # extras key set is toggle-independent.
+        f"{prefix}_ingest_batch_mean": round(
+            agg.get("ingest_frames", 0) / max(agg.get("ingest_ticks", 0), 1), 2
+        ),
+        f"{prefix}_ingest_ticks_per_sec": round(
+            agg.get("ingest_ticks", 0) / dt, 1
+        ),
         f"{prefix}_batched_verifies": batch_stats.get(usig_queue, {}).get("items", 0),
         f"{prefix}_batches": batch_stats.get(usig_queue, {}).get("batches", 0),
         f"{prefix}_mean_batch": round(
@@ -1333,6 +1363,57 @@ async def _bench_readonly(n=4, f=1, n_reads=4000, n_clients=16) -> dict:
             await r.stop()
 
 
+def bench_ingest_sweep(n_requests: int = 600, n_clients: int = 16) -> dict:
+    """Ingest-batch-size sweep: one short in-process e2e config per
+    operating point of the bundle-ingest runtime —
+
+    - ``ingest_off``: MINBFT_BUNDLE_INGEST=0, the per-frame-task path
+      (the A/B baseline perf/BATCH_RUNTIME.md reads);
+    - ``ingest{K}``: bundle ingest with MINBFT_INGEST_MAX=K flat frames
+      per tick.
+
+    Each point emits the standard e2e keys under its prefix, so the
+    sweep's committed req/s rides next to its ``*_ingest_batch_mean`` /
+    ``*_ingest_ticks_per_sec`` fill gauges — how much bundle the drain
+    actually collects at each cap, and what that buys.  HMAC USIG keeps
+    the crypto cheap enough that the host pipeline (the thing the sweep
+    varies) dominates."""
+    out: dict = {}
+    points = [("ingest_off", None), ("ingest8", 8), ("ingest64", 64),
+              ("ingest1024", 1024)]
+    for prefix, cap in points:
+        env_before = {
+            k: os.environ.get(k)
+            for k in ("MINBFT_BUNDLE_INGEST", "MINBFT_INGEST_MAX")
+        }
+        if cap is None:
+            os.environ["MINBFT_BUNDLE_INGEST"] = "0"
+            os.environ.pop("MINBFT_INGEST_MAX", None)
+        else:
+            os.environ.pop("MINBFT_BUNDLE_INGEST", None)
+            os.environ["MINBFT_INGEST_MAX"] = str(cap)
+        try:
+            out.update(
+                asyncio.run(
+                    _bench_cluster(
+                        4, 1, n_requests, n_clients=n_clients,
+                        usig_kind="hmac", max_batch=128, prefix=prefix,
+                    )
+                )
+            )
+        except Exception as e:  # noqa: BLE001 - a failed point must not
+            # cost the sweep (or the whole artifact)
+            print(json.dumps({f"{prefix}_run": f"failed: {e}"[:300]}),
+                  file=sys.stderr, flush=True)
+        finally:
+            for k, v in env_before.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    return out
+
+
 def _last_tpu_numbers() -> "dict | None":
     """Carry-forward block for CPU-fallback runs: the newest committed
     BENCH_r*.json produced on a real TPU backend, so a reader of this
@@ -1403,7 +1484,15 @@ def main() -> None:
     n_requests = int(os.environ.get("MINBFT_BENCH_REQUESTS", "10000"))
     n_clients = int(os.environ.get("MINBFT_BENCH_CLIENTS", "100"))
 
+    # Optional uvloop (MINBFT_UVLOOP, auto-detect): installed as the
+    # policy BEFORE any asyncio.run below, recorded in the artifact so a
+    # number is never silently attributed to the wrong event loop.
+    from minbft_tpu.utils.loop import maybe_enable_uvloop
+
+    uvloop_on = maybe_enable_uvloop()
+
     extras = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+    extras["uvloop"] = uvloop_on
     extras["compile_cache_dir"] = _COMPILE_CACHE_DIR
     extras["compile_cache_entries_before"] = _COMPILE_CACHE_BEFORE
     if _BACKEND_FALLBACK is not None:
@@ -1489,6 +1578,16 @@ def main() -> None:
                 trace_run=True,
             )
         )
+    if not os.environ.get("MINBFT_BENCH_SKIP_INGEST"):
+        # Bundle-ingest operating-point sweep (host-path work — the
+        # numbers are meaningful on every backend; CPU runs shorter).
+        sweep_req = int(
+            os.environ.get(
+                "MINBFT_BENCH_INGEST_REQUESTS",
+                "400" if jax.default_backend() == "cpu" else "600",
+            )
+        )
+        extras.update(bench_ingest_sweep(sweep_req))
     if not os.environ.get("MINBFT_BENCH_SKIP_RO"):
         ro_reads = int(os.environ.get("MINBFT_BENCH_RO_READS", "4000"))
         if jax.default_backend() == "cpu" and ro_reads > 400:

@@ -98,6 +98,36 @@ class Authenticator(abc.ABC):
         """Verify ``tag`` over ``msg`` against ``peer_id``'s key for
         ``role``; raises :class:`AuthenticationError` on failure."""
 
+    @property
+    def supports_batch_verify(self) -> bool:
+        """True when :meth:`verify_message_authen_tags` lands a bundle on
+        a shared batching engine whose in-flight coalescing makes a
+        fire-and-forget SEED call free for the per-message verifications
+        that follow (the bundle-ingest runtime's preverify).  False — the
+        default — means batch verification is just a serial loop, and
+        seeding it would verify everything twice."""
+        return False
+
+    async def verify_message_authen_tags(
+        self, role: AuthenticationRole, items
+    ) -> list:
+        """Batch verification surface for the bundle-ingest runtime:
+        ``items = [(peer_id, msg, tag), ...]`` -> one entry per item,
+        ``None`` on success or the :class:`AuthenticationError` VALUE on
+        failure (errors are item-wise — one bad tag must never poison a
+        bundle).  The default verifies serially through
+        :meth:`verify_message_authen_tag`; implementations with a batch
+        engine (the sample authenticator) override it to land the whole
+        bundle in one engine call."""
+        out = []
+        for peer_id, msg, tag in items:
+            try:
+                await self.verify_message_authen_tag(role, peer_id, msg, tag)
+                out.append(None)
+            except AuthenticationError as e:
+                out.append(e)
+        return out
+
 
 class Configer(abc.ABC):
     """Protocol configuration provider (reference api/api.go:34-53)."""

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import OrderedDict
 from typing import AsyncIterator, Dict, Optional
@@ -49,6 +50,7 @@ from ..messages import (
     split_multi,
     stringify,
     unmarshal,
+    unmarshal_batch,
 )
 from ..messages.codec import CodecError
 from ..messages.authen import collection_digest as authen_collection_digest
@@ -272,6 +274,11 @@ class Handlers:
         self.sign_message_async = sign_message_async
         self.verify_signature = verify_signature
         self.verify_ui = verify_ui
+        # Exposed for the bundle-ingest seed path (preverify_requests):
+        # the seed must HIT the same verified-check memo as the
+        # per-message path (already-verified requests are skipped from
+        # the seed); feeding the memo stays the per-message path's job.
+        self._verified_hit = _verified_hit
         self.assign_ui = usig_ui.make_ui_assigner(authenticator)
         self.capture_ui = usig_ui.make_ui_capturer(self.peer_states)
 
@@ -662,6 +669,73 @@ class Handlers:
             await self._validate_log_base(msg)
         else:
             raise api.AuthenticationError(f"unexpected message {stringify(msg)}")
+
+    def preverify_requests(self, msgs) -> int:
+        """Seed the engine verify queue with a decoded ingest bundle's
+        outstanding client-signature checks in ONE batch call; returns
+        the number of checks seeded.
+
+        This is deliberately fire-and-forget, NOT a barrier: the caller
+        fans the bundle out immediately, and each message's ordinary
+        ``validate_request`` submits the same engine item moments later —
+        which COALESCES onto the in-flight lane the seed opened
+        (``_SchemeQueue._inflight_futs``), so the whole bundle dispatches
+        as one engine batch while per-message validation keeps its exact
+        semantics (failures raise item-wise on the per-message path, the
+        verified-check memo is fed there, nothing double-verifies).
+        Awaiting the batch here instead was measured to CHOP the
+        pipeline's natural processing waves: ingest ticks serialized on
+        engine round trips, requests reached the primary's proposer in
+        bundle-sized groups, and PREPAREs shrank — more USIG signing
+        (serial by design) and thinner UI-verify batches.
+
+        Skipped entirely (returns 0) in the no-dedup measurement mode:
+        with the engine's in-flight coalescing off, every seeded check
+        would occupy a SECOND device lane and the reported device rate
+        would no longer equal protocol demand.
+        """
+        if not self._dedup_verify:
+            return 0
+        if not getattr(self.authenticator, "supports_batch_verify", False):
+            # No engine behind the batch surface: a seed would verify
+            # everything twice on the serial loop for no coalescing win.
+            return 0
+        verify_many = self.authenticator.verify_message_authen_tags
+        # No trace notes and no validation marks here: the per-message
+        # path still walks its full recv -> verify_enqueue -> verify_done
+        # span sequence AND its own memo checks (a memo-hit request is
+        # merely skipped from the seed — marking it validated here would
+        # short-circuit the per-message verify_enqueue note and skew the
+        # stage table on exactly the path this runtime exists to measure).
+        role = None
+        items: list = []
+        for m in msgs:
+            if not isinstance(m, Request):
+                continue
+            if self._marked(m, "_validated_by"):
+                continue
+            ab = authen_bytes(m)
+            role = utils.signing_role(m)
+            if self._verified_hit((role, m.client_id, ab, m.signature)):
+                continue
+            items.append((m.client_id, ab, m.signature))
+        if not items:
+            return 0
+
+        async def seed() -> None:
+            # Verdicts are consumed by the per-message validations that
+            # coalesced onto these lanes; engine errors surface THERE
+            # with full per-message handling, so the seed itself only
+            # has to avoid dying loudly.
+            try:
+                await verify_many(role, items)
+            except Exception:  # pragma: no cover - engine failure path
+                pass
+
+        task = asyncio.get_running_loop().create_task(seed())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._on_bg_task_done)
+        return len(items)
 
     async def _validate_log_base(self, lb: LogBase) -> None:
         """A LOG-BASE claim is exactly its certificate: f+1 matching
@@ -1732,8 +1806,163 @@ class _ConcurrentStreamProcessor:
             await asyncio.sleep(0)
 
     def cancel(self) -> None:
-        for t in self._tasks:
+        # Snapshot: cancelling a task that is already FINISHING can run
+        # its done-callback synchronously and mutate the set mid-iteration.
+        for t in list(self._tasks):
             t.cancel()
+
+
+# Bundle-ingest knobs.  MINBFT_BUNDLE_INGEST=0 reverts every stream pump
+# to the per-frame-task path (the A/B lever perf/BATCH_RUNTIME.md uses);
+# MINBFT_INGEST_MAX bounds the flat frames drained into one tick's bundle
+# (the bench's ingest-batch-size sweep axis).  Read per stream setup, so
+# tests and the bench sweep can toggle without reimporting.
+_BUNDLE_ENV = "MINBFT_BUNDLE_INGEST"
+_INGEST_MAX_ENV = "MINBFT_INGEST_MAX"
+# Transport frames buffered between the stream pump and the tick loop:
+# when full, the pump's put() blocks and the transport sees backpressure
+# (the same role the submit semaphore plays for in-flight tasks).
+_INGEST_RX_BOUND = 256
+_INGEST_EOF = object()
+
+
+def bundle_ingest_enabled() -> bool:
+    return os.environ.get(_BUNDLE_ENV, "").lower() not in ("0", "false", "no")
+
+
+def _ingest_max_frames() -> int:
+    try:
+        return max(1, int(os.environ.get(_INGEST_MAX_ENV, "1024")))
+    except ValueError:
+        return 1024
+
+
+class _BundleIngestor:
+    """Tick-driven bundle ingest for one incoming stream.
+
+    Replaces per-frame task spawning on the stream's decode/validate hot
+    path: a pump task moves transport frames into a bounded queue, and
+    the tick loop drains EVERYTHING buffered per iteration into one flat
+    frame bundle — the ``drain_multi`` write-side pattern mirrored on
+    read.  The bundle is decoded in one vectorized call
+    (``messages.codec.unmarshal_batch``, item-wise errors), its
+    signature checks are SEEDED to the engine verify queue in one call
+    (client streams; see :meth:`Handlers.preverify_requests` — the
+    per-message validations coalesce onto the seeded lanes), and the
+    messages fan out to the ordered processing pipeline — per-peer UI
+    capture and per-client seq capture stay the ordering boundary,
+    exactly the batching-vs-ordering split documented on
+    :class:`_ConcurrentStreamProcessor`.
+
+    Concurrency: every attribute is confined to the owning event loop
+    (the pump and tick tasks of ONE stream; LD-spec'd in
+    tools/analyze/project.py).  ``_eof_pending`` is the pump's non-edge
+    EOF signal: the sentinel put can be dropped by a full queue, the
+    flag cannot — the tick loop checks it whenever the queue runs dry.
+    """
+
+    def __init__(
+        self,
+        handlers: Handlers,
+        on_error,
+        submit,
+        preverify=None,
+        max_frames: Optional[int] = None,
+    ):
+        self._handlers = handlers
+        self._on_error = on_error
+        self._submit = submit  # async callable(Message)
+        self._preverify = preverify  # sync callable(list[Message]) -> int
+        self._max_frames = max_frames or _ingest_max_frames()
+        self._rx: asyncio.Queue = asyncio.Queue(maxsize=_INGEST_RX_BOUND)
+        self._eof_pending = False
+
+    async def run(self, in_stream: AsyncIterator[bytes]) -> None:
+        """Pump + tick until the stream ends (returns) or the caller
+        cancels (propagates)."""
+        pump = asyncio.get_running_loop().create_task(self._pump(in_stream))
+        try:
+            await self._ticks()
+        finally:
+            pump.cancel()
+            pump.add_done_callback(lambda t: t.cancelled() or t.exception())
+
+    async def _pump(self, in_stream: AsyncIterator[bytes]) -> None:
+        rx = self._rx
+        try:
+            async for data in in_stream:
+                await rx.put(data)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # An abnormal stream end (transport reset, protocol error in
+            # the generator) must stay visible: the tick loop treats the
+            # latched EOF as a clean end either way — the caller's redial
+            # machinery handles recovery — but the CAUSE belongs in the
+            # log, not the unretrieved-exception void.
+            self._handlers.metrics.inc("ingest_stream_errors")
+            self._handlers.log.warning("ingest stream failed: %r", e)
+        finally:
+            # One-way latch, loop-atomic store: the only write anywhere,
+            # and the tick loop only reads it between awaits — no
+            # read-modify-write spans a suspension.
+            self._eof_pending = True  # noqa: LD001
+            try:
+                rx.put_nowait(_INGEST_EOF)
+            except asyncio.QueueFull:
+                # The tick loop cannot be parked in get() while the queue
+                # is full — it will drain, see the flag, and stop.
+                pass
+
+    def _split_into(self, data: bytes, flat: list) -> None:
+        try:
+            flat.extend(split_multi(data))
+        except CodecError as e:
+            self._on_error(e)
+
+    async def _ticks(self) -> None:
+        rx = self._rx
+        while True:
+            if self._eof_pending and rx.empty():
+                return
+            data = await rx.get()
+            if data is _INGEST_EOF:
+                return
+            flat: list = []
+            self._split_into(data, flat)
+            saw_eof = False
+            while len(flat) < self._max_frames and not rx.empty():
+                nxt = rx.get_nowait()
+                if nxt is _INGEST_EOF:
+                    saw_eof = True
+                    break
+                self._split_into(nxt, flat)
+            await self._ingest(flat)
+            if saw_eof:
+                return
+
+    async def _ingest(self, frames: list) -> None:
+        if not frames:
+            return
+        h = self._handlers
+        h.metrics.observe_ingest(len(frames))
+        decoded = []
+        for m in unmarshal_batch(frames):
+            if isinstance(m, CodecError):
+                self._on_error(m)
+            else:
+                decoded.append(m)
+        if not decoded:
+            return
+        if self._preverify is not None:
+            tr = h.trace
+            if tr is not None:
+                for m in decoded:
+                    if isinstance(m, Request):
+                        tr.note(obs_trace.R_INGEST, m.client_id, m.seq)
+            self._preverify(decoded)
+        for m in decoded:
+            await self._submit(m)
 
 
 class _TurnSequencer:
@@ -1851,6 +2080,16 @@ class PeerStreamHandler(api.MessageStreamHandler):
         proc = _ConcurrentStreamProcessor(h.handle_peer_message, _drop_peer)
 
         async def consume_incoming() -> None:
+            if bundle_ingest_enabled():
+                # Peer bundles batch the DECODE (vectorized, item-wise
+                # errors) and the per-tick drain; validation stays
+                # per-message — PREPARE/COMMIT checks are UI-certificate
+                # work that already co-batches across the concurrent
+                # handler tasks.
+                await _BundleIngestor(h, _drop_peer, proc.submit_msg).run(
+                    in_stream
+                )
+                return
             async for data in in_stream:
                 try:
                     frames = split_multi(data)
@@ -1927,14 +2166,28 @@ class ClientStreamHandler(api.MessageStreamHandler):
         proc = _ConcurrentStreamProcessor(handle_one, _drop_client)
 
         async def consume() -> None:
-            async for data in in_stream:
-                try:
-                    frames = split_multi(data)
-                except CodecError as e:
-                    _drop_client(e)
-                    continue
-                for fr in frames:
-                    await proc.submit(fr)
+            if bundle_ingest_enabled():
+                # Bundle-ingest hot path: drain everything buffered per
+                # tick, decode it as ONE vectorized batch, seed the
+                # engine with the bundle's signature checks in one call,
+                # then fan out in arrival order (the _TurnSequencer
+                # tickets are issued in fan-out order, so the ordering
+                # boundary is unchanged).
+                await _BundleIngestor(
+                    h,
+                    _drop_client,
+                    proc.submit_msg,
+                    preverify=h.preverify_requests,
+                ).run(in_stream)
+            else:
+                async for data in in_stream:
+                    try:
+                        frames = split_multi(data)
+                    except CodecError as e:
+                        _drop_client(e)
+                        continue
+                    for fr in frames:
+                        await proc.submit(fr)
             await proc.drain()
             await out_queue.put(FIN)
 
@@ -2090,6 +2343,7 @@ async def run_peer_connection(
     peer_state = handlers.peer_states.peer(peer_id)
 
     backoff = ReconnectBackoff()
+    ingest = bundle_ingest_enabled()
     while not done.is_set():
         proc = _ConcurrentStreamProcessor(handlers.handle_peer_message, _drop, _ok)
         attempt_start = time.monotonic()
@@ -2174,8 +2428,23 @@ async def run_peer_connection(
                 except CodecError as e:
                     _drop(e)
                     continue
-                for fr in frames:
-                    await proc.submit(fr)
+                if ingest:
+                    # The publisher's drain_multi already coalesced this
+                    # frame into a bundle — decode it as one vectorized
+                    # batch (item-wise errors) and fan the typed messages
+                    # out, instead of spawning a decode task per frame.
+                    # (The dial loop keeps its own watchdog-raced read
+                    # structure, so the rx-queue tick loop is not used
+                    # here.)
+                    handlers.metrics.observe_ingest(len(frames))
+                    for m in unmarshal_batch(frames):
+                        if isinstance(m, CodecError):
+                            _drop(m)
+                        else:
+                            await proc.submit_msg(m)
+                else:
+                    for fr in frames:
+                        await proc.submit(fr)
                 if _gap_wedged():
                     handlers.metrics.inc("gap_redials")
                     handlers.log.warning(

@@ -118,3 +118,46 @@ class Log2Histogram:
     def bucket_upper_bounds_s(self) -> List[float]:
         """Upper edge of each bucket in seconds (for Prometheus ``le``)."""
         return [(1 << i) / _US for i in range(_N_BUCKETS)]
+
+
+class Log2CountHistogram(Log2Histogram):
+    """Log2 histogram over a dimensionless COUNT axis (ingest bundle
+    sizes) with the same storage, merge, and serialization as the
+    duration base class.
+
+    The ``_s``-suffixed members keep their names so the Prometheus
+    renderer (obs/prom.py) works unchanged, but the axis is plain
+    counts: ``observe_count(n)`` buckets by ceil-log2(n) (bucket i covers
+    ``(2**(i-1), 2**i]`` items, same upper-edge convention as the base),
+    ``total_s`` accumulates the raw counts (so ``_sum`` is total items
+    and ``mean_s`` the mean bundle size), and the exposed ``le`` bounds
+    are ``2**i`` items."""
+
+    __slots__ = ()
+
+    def observe_count(self, n: int) -> None:
+        idx = (n - 1).bit_length() if n > 1 else 0
+        self.buckets[min(idx, _N_BUCKETS - 1)] += 1
+        self.count += 1
+        self.total_s += n
+
+    @property
+    def mean(self) -> float:
+        """Mean bundle size (alias of the misleadingly-named mean_s)."""
+        return self.mean_s
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile in ITEMS (bucket upper edge)."""
+        if not self.count:
+            return 0.0
+        rank = max(1, -(-int(q * self.count) // 100))
+        seen = 0
+        for i, c in enumerate(self.buckets):
+            seen += c
+            if seen >= rank:
+                return float(1 << i)
+        return float(1 << (_N_BUCKETS - 1))
+
+    def bucket_upper_bounds_s(self) -> List[float]:
+        """Upper edge of each bucket in ITEMS (for Prometheus ``le``)."""
+        return [float(1 << i) for i in range(_N_BUCKETS)]

@@ -138,6 +138,17 @@ def collect_replica(
                     [(base, exec_hist)],
                 )
             )
+        ingest_hist = getattr(metrics, "ingest_hist", None)
+        if ingest_hist is not None and ingest_hist.count:
+            fams.append(
+                (
+                    "minbft_ingest_bundle_frames",
+                    "histogram",
+                    "frames decoded per ingest tick (le = bundle size in "
+                    "frames, log2 buckets — the bundle-fill distribution)",
+                    [(base, ingest_hist)],
+                )
+            )
     if recorder is not None:
         samples = []
         for name, h in recorder.stage_hists().items():

@@ -43,8 +43,13 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from .hist import Log2Histogram
 
-# Replica capture points, in pipeline order.
+# Replica capture points, in pipeline order.  ``ingest`` is the
+# bundle-runtime entry (the tick that decoded this request's frame
+# bundle); ``recv`` is the legacy per-task entry (MINBFT_BUNDLE_INGEST=0)
+# — both are ENTRY stages (they open spans, never record durations), so
+# retransmit gaps can't pollute the cost table on either path.
 REPLICA_STAGES: Tuple[str, ...] = (
+    "ingest",
     "recv",
     "verify_enqueue",
     "verify_done",
@@ -54,14 +59,17 @@ REPLICA_STAGES: Tuple[str, ...] = (
     "reply_sign",
     "reply_sent",
 )
-R_RECV = 0
-R_VERIFY_ENQUEUE = 1
-R_VERIFY_DONE = 2
-R_PREPARE = 3
-R_COMMIT_QUORUM = 4
-R_EXECUTE = 5
-R_REPLY_SIGN = 6
-R_REPLY_SENT = 7
+R_INGEST = 0
+R_RECV = 1
+R_VERIFY_ENQUEUE = 2
+R_VERIFY_DONE = 3
+R_PREPARE = 4
+R_COMMIT_QUORUM = 5
+R_EXECUTE = 6
+R_REPLY_SIGN = 7
+R_REPLY_SENT = 8
+# Stages that never close a span (see FlightRecorder.note).
+_REPLICA_ENTRY_STAGES = frozenset((R_INGEST, R_RECV))
 
 # Client capture points ("start" is the implicit entry of request()).
 CLIENT_STAGES: Tuple[str, ...] = (
@@ -199,6 +207,7 @@ class FlightRecorder:
         ident: int,
         stages: Tuple[str, ...],
         ring_capacity: Optional[int] = None,
+        entry_stages: Optional[frozenset] = None,
     ):
         if ring_capacity is None:
             ring_capacity = int(os.environ.get(_RING_ENV, _DEFAULT_RING))
@@ -208,12 +217,21 @@ class FlightRecorder:
         self.ring = StageRing(ring_capacity)
         self.hists: List[Log2Histogram] = [Log2Histogram() for _ in stages]
         self._final = len(stages) - 1
+        # Pipeline entries: stages that open a span but never close one
+        # (a retransmission re-noting an entry mid-pipeline must not fold
+        # its gap into the cost table).  Default: stage 0 only.
+        self._entries = frozenset((0,)) if entry_stages is None else entry_stages
         # (cid, seq) -> monotonic-ns of the previous noted point.
         self._last: Dict[Tuple[int, int], int] = {}
 
     @staticmethod
     def for_replica(replica_id: int) -> "FlightRecorder":
-        return FlightRecorder("replica", replica_id, REPLICA_STAGES)
+        return FlightRecorder(
+            "replica",
+            replica_id,
+            REPLICA_STAGES,
+            entry_stages=_REPLICA_ENTRY_STAGES,
+        )
 
     @staticmethod
     def for_client(client_id: int) -> "FlightRecorder":
@@ -225,10 +243,10 @@ class FlightRecorder:
         key = (cid, seq)
         last = self._last
         prev = last.get(key)
-        if prev is not None and stage != 0:
-            # Stage 0 (recv/start) is the pipeline ENTRY: it opens a
-            # span but never closes one — a client retransmission
-            # re-noting recv mid-pipeline would otherwise fold the
+        if prev is not None and stage not in self._entries:
+            # Entry stages (ingest/recv on replicas, start on clients)
+            # open spans but never close one — a client retransmission
+            # re-noting an entry mid-pipeline would otherwise fold the
             # 30s retransmit gap into the cost table as "recv time".
             # (The raw ring still keeps the duplicate arrival for
             # forensics.)

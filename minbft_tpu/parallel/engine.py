@@ -438,6 +438,25 @@ class _SchemeQueue(_DispatchQueue):
         return self.engine._host_fallback_for(self.name)
 
     def submit(self, item) -> "asyncio.Future | _Resolved":
+        out = self._enqueue(item)
+        if self.pending:
+            self._schedule_flush(None)
+        return out
+
+    def submit_many(self, items) -> list:
+        """Batch entry point (the ingest runtime's one-call feed): enqueue
+        every item, then schedule ONE flush — the whole bundle lands in
+        ``pending`` before any dispatch decision, so a decoded ingest
+        bundle becomes at most ceil(len/max_batch) device batches instead
+        of racing item-by-item against the idle flush.  Returns one
+        awaitable per item (memo hits resolve instantly, duplicates share
+        lanes — exactly :meth:`submit`'s semantics, item-wise)."""
+        outs = [self._enqueue(it) for it in items]
+        if self.pending:
+            self._schedule_flush(None)
+        return outs
+
+    def _enqueue(self, item) -> "asyncio.Future | _Resolved":
         if not self.engine.dedup:
             # Measurement mode (round-4 verdict weak #1): every submission
             # occupies its own device lane — no memo, no in-flight
@@ -448,7 +467,7 @@ class _SchemeQueue(_DispatchQueue):
             fut = loop.create_future()
             self._inflight_futs.setdefault(item, []).append(fut)
             self.pending.append((item, fut))
-            return self._schedule_flush(fut)
+            return fut
         verdict = self._memo.get(item)
         if verdict is None:
             verdict = self._neg_memo.get(item)
@@ -471,7 +490,7 @@ class _SchemeQueue(_DispatchQueue):
             return fut
         self._inflight_futs[item] = [fut]
         self.pending.append((item, fut))
-        return self._schedule_flush(fut)
+        return fut
 
     def _resolve_error(self, batch, e: BaseException) -> None:
         for it, _ in batch:
@@ -817,6 +836,44 @@ class BatchVerifier:
     async def verify_ed25519_host(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
         q = self._queue("ed25519_host", self._dispatch_ed25519_host)
         return await q.submit((pub, msg, sig))
+
+    async def _verify_many(self, name: str, dispatch, items) -> list:
+        """Whole-bundle verification feed (the batch-ingest runtime's one
+        engine call per decoded bundle): every item lands in the queue
+        before ONE flush decision, so an N-item bundle dispatches as
+        ~N/max_batch device batches instead of N racing idle flushes.
+        Returns per-item verdicts in input order."""
+        q = self._queue(name, dispatch)
+        outs = q.submit_many(items)
+        # Gather with return_exceptions so EVERY lane's outcome is
+        # consumed even when the batch errors — awaiting sequentially
+        # would abandon lanes 2..N after the first raise and spam
+        # "Future exception was never retrieved" at GC.
+        results = await asyncio.gather(*outs, return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+        return list(results)
+
+    async def verify_ecdsa_p256_many(self, items) -> list:
+        """Batch sibling of :meth:`verify_ecdsa_p256`:
+        ``items = [((qx, qy), digest32, (r, s)), ...]`` -> [bool, ...]."""
+        return await self._verify_many("ecdsa_p256", self._dispatch_ecdsa, items)
+
+    async def verify_ecdsa_p256_host_many(self, items) -> list:
+        return await self._verify_many(
+            "ecdsa_p256_host", self._dispatch_ecdsa_host, items
+        )
+
+    async def verify_ed25519_many(self, items) -> list:
+        """Batch sibling of :meth:`verify_ed25519`:
+        ``items = [(pub32, msg, sig64), ...]`` -> [bool, ...]."""
+        return await self._verify_many("ed25519", self._dispatch_ed25519, items)
+
+    async def verify_ed25519_host_many(self, items) -> list:
+        return await self._verify_many(
+            "ed25519_host", self._dispatch_ed25519_host, items
+        )
 
     async def verify_nist_host(
         self, curve: str, pub: bytes, msg: bytes, sig: bytes

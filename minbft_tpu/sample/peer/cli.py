@@ -929,6 +929,12 @@ def main(argv=None) -> int:
     path, explicit = peek_options_path(argv)
     args = build_parser(load_peer_options(path, explicit)).parse_args(argv)
     if args.command == "run":
+        # Optional uvloop (MINBFT_UVLOOP, auto-detected): must be
+        # installed as the policy BEFORE asyncio.run creates the loop.
+        from ...utils.loop import maybe_enable_uvloop
+
+        if maybe_enable_uvloop():
+            logging.getLogger("minbft.peer").info("event loop: uvloop")
         return asyncio.run(_run_replica(args))
     if args.command == "metrics":
         return _run_metrics_scrape(args)

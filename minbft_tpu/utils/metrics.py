@@ -84,7 +84,7 @@ class ReplicaMetrics:
     """
 
     def __init__(self):
-        from ..obs.hist import Log2Histogram
+        from ..obs.hist import Log2CountHistogram, Log2Histogram
 
         self.counters: Dict[str, int] = {}
         self.execute_latency = LatencyReservoir()
@@ -93,6 +93,12 @@ class ReplicaMetrics:
         # Prometheus exposition; the reservoir keeps exact samples for
         # the snapshot()/bench percentiles.
         self.execute_hist = Log2Histogram()
+        # Bundle-ingest fill distribution: one observation per ingest
+        # tick, value = decoded frames in that tick's bundle (log2
+        # buckets, mergeable, scraped as minbft_ingest_bundle_frames).
+        # The companion counters (ingest_ticks / ingest_frames) ride the
+        # ordinary counter map so snapshot()/aggregate() carry them.
+        self.ingest_hist = Log2CountHistogram()
         self._started = time.monotonic()
 
     def inc(self, name: str, by: int = 1) -> None:
@@ -101,6 +107,14 @@ class ReplicaMetrics:
     def observe_execute(self, seconds: float) -> None:
         self.execute_latency.observe(seconds)
         self.execute_hist.observe(seconds)
+
+    def observe_ingest(self, n_frames: int) -> None:
+        """One bundle-ingest tick that decoded ``n_frames`` flat frames."""
+        self.counters["ingest_ticks"] = self.counters.get("ingest_ticks", 0) + 1
+        self.counters["ingest_frames"] = (
+            self.counters.get("ingest_frames", 0) + n_frames
+        )
+        self.ingest_hist.observe_count(n_frames)
 
     @property
     def uptime_s(self) -> float:

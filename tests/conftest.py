@@ -23,6 +23,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Event-loop matrix: MINBFT_UVLOOP=1 runs the whole selected suite under
+# uvloop (CI's uvloop step re-runs the chaos seeds + the metrics endpoint
+# this way), so event-loop-policy-sensitive code — the bundle-ingest tick
+# loops, the stream pumps, the metrics server — is exercised on both
+# loops.  Tests require the EXPLICIT opt-in (no auto-detect): the default
+# suite must measure the stdlib loop every run, even on hosts where the
+# perf extra happens to be installed.
+from minbft_tpu.utils.loop import maybe_enable_uvloop, uvloop_requested  # noqa: E402
+
+if uvloop_requested():
+    maybe_enable_uvloop()
+
 
 async def make_cluster(
     n=4, f=1, n_clients=1, usig_kind="hmac", cfg=None, wrap_conn=None,

@@ -66,9 +66,11 @@ def test_collect_replica_families_from_live_objects():
     m = ReplicaMetrics()
     m.inc("requests_executed", 2)
     m.observe_execute(0.01)
+    from minbft_tpu.obs.trace import R_INGEST, R_VERIFY_ENQUEUE
+
     rec = FlightRecorder.for_replica(1)
-    rec.note(0, 0, 1)
-    rec.note(1, 0, 1)
+    rec.note(R_INGEST, 0, 1)
+    rec.note(R_VERIFY_ENQUEUE, 0, 1)
     text = render_families(collect_replica(metrics=m, recorder=rec, replica_id=1))
     assert 'minbft_requests_executed_total{replica="1"} 2' in text
     assert "minbft_uptime_seconds" in text
@@ -220,6 +222,11 @@ _PINNED_BENCH_KEYS = {
     "pin_clients",
     "pin_requests",
     "pin_committed_req_per_sec",
+    # Bundle-ingest fill gauges (ISSUE 6): ALWAYS present — 0-valued when
+    # MINBFT_BUNDLE_INGEST=0 — so the key set cannot depend on a runtime
+    # toggle (the byte-identical contract this pin enforces).
+    "pin_ingest_batch_mean",
+    "pin_ingest_ticks_per_sec",
     "pin_batched_verifies",
     "pin_batches",
     "pin_mean_batch",

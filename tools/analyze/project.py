@@ -205,6 +205,25 @@ def default_config() -> AnalyzeConfig:
                 locks=(),
                 guarded=("_by_client",),
             ),
+            # Bundle-ingest runtime (ISSUE 6): one pump + one tick task
+            # per stream share the rx queue and the pump's EOF flag —
+            # loop-confined, so the suspension-aware mode flags any
+            # mutation racing an await without a lock.
+            LockClassSpec(
+                path="minbft_tpu/core/message_handling.py",
+                cls="_BundleIngestor",
+                locks=(),
+                guarded=("_rx", "_eof_pending", "_max_frames"),
+            ),
+            # Tick accounting the ingest path feeds from the event loop;
+            # the Prometheus scrape thread only READS (GIL-atomic ints,
+            # the documented monitoring contract).
+            LockClassSpec(
+                path="minbft_tpu/utils/metrics.py",
+                cls="ReplicaMetrics",
+                locks=(),
+                guarded=("counters", "ingest_hist"),
+            ),
             # The batching engine is the one place real threads touch
             # shared state (dispatchers run via asyncio.to_thread):
             # kernel memo and cross-thread stats need their locks held on
