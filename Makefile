@@ -12,7 +12,12 @@
 #                    reference); (3) ruff (preferred, [tool.ruff] in
 #                    pyproject.toml) or pyflakes when installed
 #   make fast        native + lint + the unit tier of the test suite (<2min)
-#   make check       native + lint + the FULL test suite (~9min, what CI runs)
+#   make check       native + lint + gate + the FULL test suite (~9min,
+#                    what CI runs)
+#   make gate        bench regression gate (tools/benchgate): the working
+#                    tree's BENCH_extras.json vs the committed
+#                    perf/BENCH_baseline.json, stddev-aware, hard-refusing
+#                    cross-backend comparisons (tpu_unavailable caution)
 #   make check-race  race tier (VERDICT #5): native usig_test rebuilt and
 #                    run under ThreadSanitizer (concurrent certification
 #                    hammer); skips with a notice if the toolchain lacks
@@ -28,7 +33,7 @@
 PY ?= python
 CXX ?= g++
 
-.PHONY: native lint fast check check-race chaos test bench clean
+.PHONY: native lint gate fast check check-race chaos test bench clean
 
 native:
 	$(MAKE) -C minbft_tpu/native
@@ -81,7 +86,14 @@ fast: native lint
 	    --ignore=tests/test_soak_bounded.py \
 	    --ignore=tests/test_stress_concurrent.py
 
-check: native lint
+# Bench regression gate: the committed artifacts must stay in-band.
+# Deterministic (both inputs are committed files), so CI cannot flake
+# here — a failure means a regenerated artifact actually regressed, or
+# someone tried to gate across backend kinds (hard refusal, rc=2).
+gate:
+	$(PY) -m tools.benchgate
+
+check: native lint gate
 	$(PY) -m pytest tests/ -q
 
 test: check

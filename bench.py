@@ -33,6 +33,18 @@ bench_extras line carries the headline-grade subset):
       (log2-histogram resolution: a factor of 2); _share is the stage's
       fraction of total replica-side recorded time (replica shares sum
       to 1).  perf/FLIGHT_RECORDER.md explains how to read the table.
+  {prefix}_critpath_{segment}_share
+      cluster-wide causal critical path (minbft_tpu/obs/critpath.py,
+      ISSUE 8), from the SAME traced pass: the per-process dumps merged
+      into one timeline per (client_id, seq) — client_sign/client_gate →
+      ingress (+ the loop_lag carve from the event-loop lag sampler) →
+      preverify → queue_wait/verify (split by the engine queue-wait
+      histograms) → prepare_wait → commit → execute → reply_sign →
+      reply_send → reply_net, plus the honest unattributed residual.
+      Shares sum to 1.0; companions: _critpath_requests / _skipped /
+      _total_p50_ms / _clock_err_ms (the clockalign uncertainty bound) /
+      _negative_spans (clock-sanity, only when nonzero).
+      perf/CRITICAL_PATH.md explains how to produce and read the table.
   {prefix}_{queue}_prep_share                              host-prep share
       of each device queue's dispatch time in that e2e config
       (VerifyStats.host_prep_time_s / device_time_s — the prep/device
@@ -818,7 +830,11 @@ def _bench_cluster_repeated(*args, **kw) -> dict:
                 _bench_cluster(*tr_args, **dict(kw, trace=True))
             )
             out.update(
-                {k: v for k, v in traced.items() if "_stage_" in k}
+                {
+                    k: v
+                    for k, v in traced.items()
+                    if "_stage_" in k or "_critpath_" in k
+                }
             )
         except Exception as e:  # noqa: BLE001 - attribution is additive;
             # a failed traced pass must not discard the timed results
@@ -1157,20 +1173,31 @@ async def _bench_cluster(
         import shutil
         import tempfile
 
+        from minbft_tpu.obs import critpath as obs_critpath
         from minbft_tpu.obs import trace as obs_trace
 
         tdir = tempfile.mkdtemp(prefix="minbft-trace.")
         base = os.path.join(tdir, "trace")
         try:
             for r in replicas:
-                if r.trace is not None:
-                    obs_trace.dump_recorder(r.trace, base=base)
+                # dump_trace carries n/f (the critpath quorum rank) and
+                # the loop-lag histogram alongside the stage spans.
+                r.dump_trace(base=base)
             for c in clients:
                 if c._trace is not None:
                     obs_trace.dump_recorder(c._trace, base=base)
-            stage_keys = obs_trace.stage_table(
-                obs_trace.load_dumps(base), prefix
-            )
+            # Engine queue-wait/service histograms, one doc per engine:
+            # the wait/service ratio splits the critpath's verify and
+            # reply_sign spans into queue_wait vs device/host service.
+            for i, e in enumerate({id(e): e for e in engines}.values()):
+                with open(f"{base}.engine{i}.json", "w") as fh:
+                    json.dump(obs_critpath.engine_queue_doc(e, ident=i), fh)
+            docs = obs_trace.load_dumps(base)
+            stage_keys = obs_trace.stage_table(docs, prefix)
+            # Cluster critical path (ISSUE 8): the cross-recorder merge
+            # of the same dumps — {prefix}_critpath_{segment}_share keys
+            # summing to 1.0, queue-wait and loop-lag carved out.
+            stage_keys.update(obs_critpath.critpath_table(docs, prefix))
         finally:
             shutil.rmtree(tdir, ignore_errors=True)
     # Every replica must have executed every committed request (plus the
@@ -1756,6 +1783,7 @@ def main() -> None:
         "request_latency_p50_ms",
         "request_latency_p99_ms",
         "_stage_",
+        "_critpath_",
         "mean_batch",
         "logical_verifies",
         "memo_hits",

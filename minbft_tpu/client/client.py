@@ -168,8 +168,29 @@ class Client:
                 raise ValueError(f"no connection for replica {rid}")
             q: asyncio.Queue = asyncio.Queue()
             self._queues[rid] = q
-            self._tasks.append(loop.create_task(self._run_connection(rid, handler, q)))
+            task = loop.create_task(self._run_connection(rid, handler, q))
+            # A connection task dying with an exception (a bug — the loop
+            # is designed to swallow transport errors and redial) must
+            # not lose the trace: dump on the fatal error, not only on a
+            # clean stop() (the crashed-soak blind spot).
+            task.add_done_callback(self._on_task_done)
+            self._tasks.append(task)
         self._started = True
+
+    def _on_task_done(self, task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        self._log.error(
+            "client %d task %s died: %r", self.client_id, task.get_name(), exc
+        )
+        if self._trace is not None:
+            try:
+                obs_trace.dump_recorder(self._trace)
+            except OSError:
+                pass
 
     async def stop(self) -> None:
         for t in self._tasks:

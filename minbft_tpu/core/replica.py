@@ -47,6 +47,7 @@ class _Replica(api.Replica):
         self._connector = connector
         self._done = asyncio.Event()
         self._tasks: list = []
+        self._lag_sampler = None
 
         message_log = MessageLog()
         unicast_logs: Dict[int, MessageLog] = {
@@ -102,19 +103,70 @@ class _Replica(api.Replica):
                     )
                 )
             )
+        # Event-loop lag sampler (obs/looplag.py): scheduled-vs-actual
+        # wakeup delta into metrics.loop_lag — GIL/loop saturation as a
+        # scrapeable histogram and a trace-dump extra.
+        from ..obs.looplag import maybe_sampler
+
+        self._lag_sampler = maybe_sampler(self.handlers.metrics.loop_lag)
+        if self._lag_sampler is not None:
+            self._lag_sampler.start()
+        # Crash forensics: a protocol task dying with an exception must
+        # not take the flight-recorder trace with it — the dump fires on
+        # the fatal error, not only on a clean stop() (a crashed soak
+        # otherwise loses exactly the trace that explains it).
+        for t in self._tasks:
+            t.add_done_callback(self._on_task_done)
+
+    def trace_dump_extra(self) -> dict:
+        """Cluster-merge context carried in this replica's trace dump:
+        n/f (the critpath quorum rank) and the sampled loop-lag
+        histogram (the critpath loop_lag segment)."""
+        return {
+            "n": self.n,
+            "f": self.f,
+            "loop_lag": self.handlers.metrics.loop_lag.to_dict(),
+        }
+
+    def dump_trace(self, base=None):
+        """Write this replica's flight-recorder dump (None when tracing
+        is off or no dump base is configured)."""
+        if self.handlers.trace is None:
+            return None
+        from ..obs import trace as obs_trace
+
+        return obs_trace.dump_recorder(
+            self.handlers.trace, base=base, extra=self.trace_dump_extra()
+        )
+
+    def _on_task_done(self, task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        self.handlers.log.error(
+            "replica %d task %s died: %r", self.id, task.get_name(), exc
+        )
+        try:
+            self.dump_trace()
+        except OSError:  # dump target gone — the crash itself still logs
+            pass
 
     async def stop(self) -> None:
         self._done.set()
+        if self._lag_sampler is not None:
+            self._lag_sampler.stop()
+            self._lag_sampler = None
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks.clear()
-        if self.handlers.trace is not None:
-            # JSON trace dump on shutdown (no-op unless MINBFT_TRACE_DUMP
-            # is set): one file per replica, bench.py ingests them.
-            from ..obs import trace as obs_trace
-
-            obs_trace.dump_recorder(self.handlers.trace)
+        # JSON trace dump on shutdown (no-op unless MINBFT_TRACE_DUMP is
+        # set): one file per replica, bench.py ingests them.  A crash
+        # dump may already exist — this overwrites it with the complete
+        # ring (same path, fuller data).
+        self.dump_trace()
 
 
 def new_replica(

@@ -1,6 +1,7 @@
-"""Observability subsystem: the protocol flight recorder.
+"""Observability subsystem: the protocol flight recorder and the
+cluster critical path.
 
-Three layers (ISSUE 4; SURVEY.md §5 notes the reference's only
+Layers (ISSUEs 4 and 8; SURVEY.md §5 notes the reference's only
 instrumentation is leveled logging):
 
 - :mod:`~minbft_tpu.obs.trace` — per-request stage spans into
@@ -8,10 +9,19 @@ instrumentation is leveled logging):
   JSON trace dump (``MINBFT_TRACE_DUMP=path``) bench.py ingests;
 - :mod:`~minbft_tpu.obs.hist` — fixed-bucket mergeable latency
   histograms (the streaming counterpart of the exact-but-unmergeable
-  :class:`~minbft_tpu.utils.metrics.LatencyReservoir`);
+  :class:`~minbft_tpu.utils.metrics.LatencyReservoir`), with negative
+  durations counted, never silently clamped;
 - :mod:`~minbft_tpu.obs.prom` — Prometheus text exposition served from
   an stdlib HTTP endpoint (``peer run --metrics-port`` / the
-  ``peer metrics`` scrape subcommand).
+  ``peer metrics`` scrape subcommand, which can also merge several
+  targets into one cluster aggregate);
+- :mod:`~minbft_tpu.obs.clockalign` — NTP-free pairwise clock-offset
+  estimation from the protocol's own matched send/recv span pairs;
+- :mod:`~minbft_tpu.obs.critpath` — the cross-node trace merge: one
+  causal timeline per committed request, with queue-wait and loop-lag
+  attribution (perf/CRITICAL_PATH.md);
+- :mod:`~minbft_tpu.obs.looplag` — event-loop scheduling-lag sampler
+  (GIL/loop saturation as a first-class metric).
 
 Nothing in this package is reachable from jitted code (enforced by the
 ``tools/analyze`` trace-purity pass), and with tracing disabled the

@@ -25,12 +25,21 @@ _US = 1_000_000.0
 
 
 class Log2Histogram:
-    """Mergeable log2-bucket histogram of durations in seconds."""
+    """Mergeable log2-bucket histogram of durations in seconds.
 
-    __slots__ = ("buckets", "count", "total_s")
+    Negative durations (clock weirdness: a monotonic source going
+    backwards can only mean a broken pairing or a cross-clock subtraction
+    that should have gone through :mod:`~minbft_tpu.obs.clockalign`) are
+    COUNTED in ``negatives`` instead of silently clamped into bucket 0 —
+    the count rides the dump/merge/Prometheus surfaces so the critpath
+    merge can use it as a clock-sanity signal, and the percentile buckets
+    stay unpolluted.
+    """
+
+    __slots__ = ("buckets", "count", "total_s", "negatives")
 
     def __init__(self, buckets: Optional[List[int]] = None,
-                 count: int = 0, total_s: float = 0.0):
+                 count: int = 0, total_s: float = 0.0, negatives: int = 0):
         if buckets is None:
             buckets = [0] * _N_BUCKETS
         elif len(buckets) != _N_BUCKETS:
@@ -38,27 +47,35 @@ class Log2Histogram:
         self.buckets = buckets
         self.count = count
         self.total_s = total_s
+        self.negatives = negatives
 
     def observe(self, seconds: float) -> None:
+        if seconds < 0:
+            self.negatives += 1
+            return
         # Round UP to whole microseconds so a bucket's upper edge always
         # bounds its samples (1.2us must land above the <=1us bucket —
         # flooring would report percentiles BELOW the true value).
         us = -int(-seconds * _US // 1)
         # int.bit_length is the log2: bucket 0 <= 1us, bucket i covers
-        # (2**(i-1), 2**i] us.  Negative durations (clock weirdness)
-        # clamp into bucket 0 rather than corrupting the array.
+        # (2**(i-1), 2**i] us.
         idx = (us - 1).bit_length() if us > 1 else 0
         self.buckets[min(idx, _N_BUCKETS - 1)] += 1
         self.count += 1
         self.total_s += seconds
 
-    def observe_ns(self, ns: int) -> None:
-        """Integer fast path for ring drains (timestamps in nanoseconds)."""
+    def observe_ns(self, ns: int, n: int = 1) -> None:
+        """Integer fast path for ring drains (timestamps in nanoseconds).
+        ``n`` records the same duration n times at O(1) cost — the
+        engine's per-batch service spans apply to every lane at once."""
+        if ns < 0:
+            self.negatives += n
+            return
         us = -(-ns // 1000)  # ceil-divide: see observe()
         idx = (us - 1).bit_length() if us > 1 else 0
-        self.buckets[min(idx, _N_BUCKETS - 1)] += 1
-        self.count += 1
-        self.total_s += ns * 1e-9
+        self.buckets[min(idx, _N_BUCKETS - 1)] += n
+        self.count += n
+        self.total_s += ns * 1e-9 * n
 
     @property
     def mean_s(self) -> float:
@@ -83,6 +100,7 @@ class Log2Histogram:
         same fixed edges (the property reservoirs lack)."""
         self.count += other.count
         self.total_s += other.total_s
+        self.negatives += other.negatives
         b, ob = self.buckets, other.buckets
         for i in range(_N_BUCKETS):
             b[i] += ob[i]
@@ -99,12 +117,16 @@ class Log2Histogram:
 
     def to_dict(self) -> dict:
         # Sparse encoding: {bucket_index: count} — most of the 64 buckets
-        # are empty for any one stage.
-        return {
+        # are empty for any one stage.  ``negatives`` only when nonzero
+        # (dump compatibility both ways: old dumps simply lack the key).
+        out = {
             "buckets": {str(i): c for i, c in enumerate(self.buckets) if c},
             "count": self.count,
             "total_s": self.total_s,
         }
+        if self.negatives:
+            out["negatives"] = self.negatives
+        return out
 
     @staticmethod
     def from_dict(d: dict) -> "Log2Histogram":
@@ -112,7 +134,8 @@ class Log2Histogram:
         for i, c in (d.get("buckets") or {}).items():
             buckets[int(i)] = int(c)
         return Log2Histogram(
-            buckets, int(d.get("count", 0)), float(d.get("total_s", 0.0))
+            buckets, int(d.get("count", 0)), float(d.get("total_s", 0.0)),
+            int(d.get("negatives", 0)),
         )
 
     def bucket_upper_bounds_s(self) -> List[float]:
@@ -136,6 +159,9 @@ class Log2CountHistogram(Log2Histogram):
     __slots__ = ()
 
     def observe_count(self, n: int) -> None:
+        if n < 0:
+            self.negatives += 1
+            return
         idx = (n - 1).bit_length() if n > 1 else 0
         self.buckets[min(idx, _N_BUCKETS - 1)] += 1
         self.count += 1

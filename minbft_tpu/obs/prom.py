@@ -43,7 +43,12 @@ def _fmt_value(v) -> str:
 
 
 def render_families(families: Iterable[Family]) -> str:
-    """Render metric families to Prometheus text format."""
+    """Render metric families to Prometheus text format.
+
+    Histogram samples with a nonzero ``negatives`` counter (clock
+    weirdness — obs/hist.py) additionally emit a sibling
+    ``{name}_negatives_total`` counter family: the count is part of the
+    exposition, never silently dropped."""
     lines: List[str] = []
     for name, mtype, help_text, samples in families:
         if not samples:
@@ -51,6 +56,7 @@ def render_families(families: Iterable[Family]) -> str:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         if mtype == "histogram":
+            neg_samples: List[Tuple[Dict[str, str], int]] = []
             for labels, hist in samples:
                 assert isinstance(hist, Log2Histogram)
                 bounds = hist.bucket_upper_bounds_s()
@@ -84,6 +90,19 @@ def render_families(families: Iterable[Family]) -> str:
                     f"{name}_sum{_fmt_labels(labels)} {_fmt_value(hist.total_s)}"
                 )
                 lines.append(f"{name}_count{_fmt_labels(labels)} {total}")
+                neg = getattr(hist, "negatives", 0)
+                if neg:
+                    neg_samples.append((labels, neg))
+            if neg_samples:
+                lines.append(
+                    f"# HELP {name}_negatives_total negative-duration "
+                    "observations dropped from the histogram (clock sanity)"
+                )
+                lines.append(f"# TYPE {name}_negatives_total counter")
+                for labels, neg in neg_samples:
+                    lines.append(
+                        f"{name}_negatives_total{_fmt_labels(labels)} {neg}"
+                    )
         else:
             for labels, value in samples:
                 lines.append(
@@ -147,6 +166,17 @@ def collect_replica(
                     "frames decoded per ingest tick (le = bundle size in "
                     "frames, log2 buckets — the bundle-fill distribution)",
                     [(base, ingest_hist)],
+                )
+            )
+        lag_hist = getattr(metrics, "loop_lag", None)
+        if lag_hist is not None and (lag_hist.count or lag_hist.negatives):
+            fams.append(
+                (
+                    "minbft_eventloop_lag_seconds",
+                    "histogram",
+                    "event-loop scheduling lag (scheduled-vs-actual wakeup "
+                    "delta sampled by obs/looplag.py — GIL/loop saturation)",
+                    [(base, lag_hist)],
                 )
             )
     if recorder is not None:
@@ -235,6 +265,8 @@ def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
         flushes: List = []
         occupancy: List = []
         depth_samples: List = []
+        wait_samples: List = []
+        service_samples: List = []
         for qname, st in sorted(stats_map.items()):
             lb = dict(base)
             lb["queue"] = qname
@@ -242,6 +274,12 @@ def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
                 counters[k].append((lb, getattr(st, k, 0)))
             seconds["device"].append((lb, st.device_time_s))
             seconds["host_prep"].append((lb, st.host_prep_time_s))
+            qw = getattr(st, "queue_wait", None)
+            if qw is not None and (qw.count or qw.negatives):
+                wait_samples.append((lb, qw))
+            qs = getattr(st, "queue_service", None)
+            if qs is not None and (qs.count or qs.negatives):
+                service_samples.append((lb, qs))
             # dict(...) snapshots before iterating: the event loop
             # inserts new reasons/buckets while this thread walks.
             for reason, cnt in sorted(
@@ -282,6 +320,14 @@ def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
         fams.append((f"{p}_batch_occupancy_total", "counter",
                      "batches by log2 occupancy bucket (pre-padding)",
                      occupancy))
+        fams.append((f"{p}_wait_seconds", "histogram",
+                     "per-item wait from enqueue to dispatch (the "
+                     "batch-formation / queue-wait attribution)",
+                     wait_samples))
+        fams.append((f"{p}_service_seconds", "histogram",
+                     "dispatch to completion (kernel + transfer + host "
+                     "prep, shared by every lane of the batch)",
+                     service_samples))
         fams.append((f"{p}_depth", "gauge",
                      "items pending in the queue right now", depth_samples))
     return fams
@@ -358,3 +404,172 @@ def scrape(url: str, timeout: float = 5.0) -> str:
         url = url.rstrip("/") + "/metrics"
     with urlopen(url, timeout=timeout) as resp:
         return resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Cluster aggregation: parse expositions back and merge them.
+#
+# The log2 histograms are exactly mergeable BY DESIGN (identical fixed
+# bucket edges everywhere — obs/hist.py), so N replicas' scrapes fold
+# into one cluster exposition with no re-binning: per-``le`` bucket
+# counts add, ``_sum``/``_count`` add, counters add.  Gauges
+# (depths, uptime) are point-in-time per process and are summed too —
+# a cluster-total reading (document accordingly; a mean would be wrong
+# for depths and a max wrong for uptime, total is at least well-defined).
+
+_SAMPLE_RE = None  # compiled lazily (parsing is a cold operator path)
+
+
+def _parse_labels(inner: str) -> Dict[str, str]:
+    import re
+
+    return {
+        m.group(1): m.group(2).replace('\\"', '"').replace("\\\\", "\\")
+        for m in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"', inner or "")
+    }
+
+
+def parse_exposition(text: str) -> Dict[str, dict]:
+    """Parse Prometheus text (format 0.0.4) into
+    ``{family: {"type", "help", "samples"}}``.
+
+    Histogram families collapse their ``_bucket``/``_sum``/``_count``
+    series back into per-sample ``{"buckets": {le: cumulative}, "sum",
+    "count"}`` keyed by the non-``le`` labels; counter/gauge samples map
+    labels→value.  Built for OUR exposition (render_families output) —
+    a general scraper it is not."""
+    import re
+
+    global _SAMPLE_RE
+    if _SAMPLE_RE is None:
+        _SAMPLE_RE = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$"
+        )
+    fams: Dict[str, dict] = {}
+    types: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            types[name] = mtype
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            helps[name] = help_text
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        sname, inner, raw = m.group(1), m.group(2), m.group(3)
+        labels = _parse_labels(inner)
+        value = float("inf") if raw == "+Inf" else float(raw)
+        # Histogram series fold back under their family name.
+        fam_name, part = sname, "value"
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sname[: -len(suffix)]
+            if sname.endswith(suffix) and types.get(base) == "histogram":
+                fam_name, part = base, suffix[1:]
+                break
+        mtype = types.get(fam_name, "untyped")
+        fam = fams.setdefault(
+            fam_name,
+            {"type": mtype, "help": helps.get(fam_name, ""), "samples": {}},
+        )
+        if mtype == "histogram":
+            le = labels.pop("le", None)
+            key = tuple(sorted(labels.items()))
+            sample = fam["samples"].setdefault(
+                key, {"buckets": {}, "sum": 0.0, "count": 0}
+            )
+            if part == "bucket" and le is not None:
+                sample["buckets"][
+                    float("inf") if le == "+Inf" else float(le)
+                ] = int(value)
+            elif part == "sum":
+                sample["sum"] = value
+            elif part == "count":
+                sample["count"] = int(value)
+        else:
+            key = tuple(sorted(labels.items()))
+            fam["samples"][key] = value
+    return fams
+
+
+def merge_expositions(texts: Iterable[str],
+                      drop_labels: Tuple[str, ...] = ("replica",)) -> str:
+    """Merge several scraped expositions into ONE cluster aggregate.
+
+    ``drop_labels`` (default: the per-process ``replica`` id) are
+    stripped before merging so the same logical series from different
+    replicas folds together.  Histograms merge exactly (cumulative
+    counts are diffed to per-bucket, summed per ``le``, re-accumulated
+    over the union grid); counters and gauges sum."""
+    merged: Dict[str, dict] = {}
+    for text in texts:
+        for name, fam in parse_exposition(text).items():
+            out = merged.setdefault(
+                name, {"type": fam["type"], "help": fam["help"], "samples": {}}
+            )
+            for key, value in fam["samples"].items():
+                key = tuple(
+                    (k, v) for k, v in key if k not in drop_labels
+                )
+                if fam["type"] == "histogram":
+                    agg = out["samples"].setdefault(
+                        key, {"buckets": {}, "sum": 0.0, "count": 0}
+                    )
+                    # cumulative -> per-bucket before summing: targets
+                    # skip empty buckets, so their ``le`` grids differ.
+                    prev = 0
+                    for le in sorted(value["buckets"]):
+                        c = value["buckets"][le]
+                        agg["buckets"][le] = (
+                            agg["buckets"].get(le, 0) + (c - prev)
+                        )
+                        prev = c
+                    agg["sum"] += value["sum"]
+                    agg["count"] += value["count"]
+                else:
+                    out["samples"][key] = out["samples"].get(key, 0) + value
+    # Render back to exposition text.
+    lines: List[str] = []
+    for name in sorted(merged):
+        fam = merged[name]
+        if not fam["samples"]:
+            continue
+        lines.append(f"# HELP {name} {fam['help']}".rstrip())
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for key in sorted(fam["samples"]):
+            labels = dict(key)
+            value = fam["samples"][key]
+            if fam["type"] == "histogram":
+                cum = 0
+                for le in sorted(value["buckets"]):
+                    cum += value["buckets"][le]
+                    lb = dict(labels)
+                    lb["le"] = "+Inf" if le == float("inf") else repr(le)
+                    lines.append(f"{name}_bucket{_fmt_labels(lb)} {cum}")
+                if float("inf") not in value["buckets"]:
+                    lb = dict(labels)
+                    lb["le"] = "+Inf"
+                    lines.append(f"{name}_bucket{_fmt_labels(lb)} {cum}")
+                lines.append(
+                    f"{name}_sum{_fmt_labels(labels)} "
+                    f"{_fmt_value(value['sum'])}"
+                )
+                lines.append(
+                    f"{name}_count{_fmt_labels(labels)} {value['count']}"
+                )
+            else:
+                v = value
+                if fam["type"] == "counter" and float(v).is_integer():
+                    v = int(v)
+                lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n"

@@ -275,6 +275,7 @@ class FlightRecorder:
             "kind": self.kind,
             "id": self.ident,
             "stages": list(self.stages),
+            "clock_domain": clock_domain(),
             "hists": {n: h.to_dict() for n, h in self.stage_hists().items()},
             "events": [
                 list(e) for e in self.ring.snapshot(limit=max_events)
@@ -284,6 +285,21 @@ class FlightRecorder:
 
 # ---------------------------------------------------------------------------
 # JSON trace dumps (MINBFT_TRACE_DUMP=path) and the bench stage table.
+
+
+def clock_domain() -> str:
+    """Identity of this process's monotonic-clock domain, stamped into
+    every dump: ``time.monotonic`` reads the system-wide boot-relative
+    CLOCK_MONOTONIC, so EVERY process on one host (one boot) shares the
+    epoch — dumps with equal domains merge with zero offset and zero
+    uncertainty, and only genuinely cross-host dumps pay the
+    Cristian-style estimation (obs/clockalign.py).  Containers with
+    private hostnames conservatively fall into separate domains even
+    when the kernel clock is shared — estimation is the safe default,
+    exactness the proven special case."""
+    import socket
+
+    return socket.gethostname()
 
 
 def dump_path_for(kind: str, ident: int, base: Optional[str] = None) -> Optional[str]:

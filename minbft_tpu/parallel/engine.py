@@ -46,6 +46,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.hist import Log2Histogram
+
 
 def _bucket_for(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
@@ -93,6 +95,16 @@ class VerifyStats:
     # labels it with the 2^k upper edge).  Both sum to ``batches``.
     flush_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
     occupancy: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # Queue-wait attribution (ISSUE 8): per-item enqueue→dispatch wait
+    # and dispatch→complete service as mergeable log2 histograms, both
+    # recorded in _run's loop-side accounting block (so for successful
+    # batches count == items; a failed dispatch records neither).
+    # Scraped as minbft_{verify,sign}_queue_{wait,service}_seconds and
+    # dumped for the critical-path merge (obs/critpath.py).
+    queue_wait: Log2Histogram = dataclasses.field(default_factory=Log2Histogram)
+    queue_service: Log2Histogram = dataclasses.field(
+        default_factory=Log2Histogram
+    )
 
     @property
     def mean_batch(self) -> float:
@@ -122,9 +134,14 @@ class SignStats:
     dispatch_timeouts: int = 0
     host_fallback_items: int = 0
     # See VerifyStats: flush-reason and log2 batch-occupancy gauges,
-    # loop-side updates only.
+    # loop-side updates only — and the queue-wait/service span
+    # histograms (same recording point and invariants).
     flush_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
     occupancy: Dict[int, int] = dataclasses.field(default_factory=dict)
+    queue_wait: Log2Histogram = dataclasses.field(default_factory=Log2Histogram)
+    queue_service: Log2Histogram = dataclasses.field(
+        default_factory=Log2Histogram
+    )
 
     @property
     def mean_batch(self) -> float:
@@ -187,7 +204,9 @@ class _DispatchQueue:
         self.engine = engine
         self.name = name
         self.dispatch = dispatch  # List[item] -> per-lane results
-        self.pending: List[Tuple[object, asyncio.Future]] = []
+        # (item, future, enqueue_monotonic_ns): the timestamp feeds the
+        # per-item queue-wait histogram at dispatch time.
+        self.pending: List[Tuple[object, asyncio.Future, int]] = []
         self._flush_handle: Optional[asyncio.Handle] = None
         self.inflight = 0
         self._consecutive_timeouts = 0
@@ -228,8 +247,8 @@ class _DispatchQueue:
         then the subclass's resolution policy.  The finally re-flush is
         what implements flush-on-completion (accumulated items ship the
         moment a dispatch slot frees up)."""
-        items = [it for it, _ in batch]
-        t0 = time.monotonic()
+        items = [it for it, _f, _t in batch]
+        t0_ns = time.monotonic_ns()
         try:
             results, fell_back = await self._dispatch_with_fallback(items)
         except Exception as e:
@@ -242,7 +261,8 @@ class _DispatchQueue:
             self.inflight -= 1  # noqa: LD001
             if self.pending:
                 self._flush_now("completion")
-        dt = time.monotonic() - t0
+        dt_ns = time.monotonic_ns() - t0_ns
+        dt = dt_ns * 1e-9
         st = self.stats
         st.items += len(batch)
         st.batches += 1
@@ -260,6 +280,15 @@ class _DispatchQueue:
         # (the common case under load) lands in ITS bucket, not one up.
         occ = (len(batch) - 1).bit_length()
         st.occupancy[occ] = st.occupancy.get(occ, 0) + 1
+        # Queue-wait attribution: per-item enqueue→dispatch wait, and the
+        # shared dispatch→complete service span fanned to every lane in
+        # one O(1) bulk observe.  Recorded HERE, with the other success
+        # accounting, so wait.count == service.count == items for every
+        # successful batch (the exported invariant).
+        wait_h = st.queue_wait
+        for _it, _f, t_enq in batch:
+            wait_h.observe_ns(t0_ns - t_enq)
+        st.queue_service.observe_ns(dt_ns, len(batch))
         self._resolve(batch, results, fell_back)
 
     # -- flush scheduling ---------------------------------------------------
@@ -466,7 +495,7 @@ class _SchemeQueue(_DispatchQueue):
             loop = asyncio.get_running_loop()
             fut = loop.create_future()
             self._inflight_futs.setdefault(item, []).append(fut)
-            self.pending.append((item, fut))
+            self.pending.append((item, fut, time.monotonic_ns()))
             return fut
         verdict = self._memo.get(item)
         if verdict is None:
@@ -489,18 +518,18 @@ class _SchemeQueue(_DispatchQueue):
             waiters.append(fut)
             return fut
         self._inflight_futs[item] = [fut]
-        self.pending.append((item, fut))
+        self.pending.append((item, fut, time.monotonic_ns()))
         return fut
 
     def _resolve_error(self, batch, e: BaseException) -> None:
-        for it, _ in batch:
+        for it, _f, _t in batch:
             for fut in self._inflight_futs.pop(it, ()):
                 if not fut.done():
                     fut.set_exception(e)
 
     def _resolve(self, batch, results, fell_back: bool) -> None:
         dedup = self.engine.dedup
-        for (it, _), ok in zip(batch, results):
+        for (it, _f, _t), ok in zip(batch, results):
             ok = bool(ok)
             if dedup:
                 # Pure function: verdicts (both ways) are stable — but they
@@ -552,11 +581,11 @@ class _SignQueue(_DispatchQueue):
     def submit(self, item) -> asyncio.Future:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self.pending.append((item, fut))
+        self.pending.append((item, fut, time.monotonic_ns()))
         return self._schedule_flush(fut)
 
     def _resolve_error(self, batch, e: BaseException) -> None:
-        for _, fut in batch:
+        for _it, fut, _t in batch:
             if not fut.done():
                 fut.set_exception(e)
 
@@ -565,7 +594,7 @@ class _SignQueue(_DispatchQueue):
             # Accounted HERE, with items, so the two counters can never
             # skew apart (e.g. across a bench warmup stats reset).
             self.stats.host_fallback_items += len(batch)
-        for (_, fut), sig in zip(batch, results):
+        for (_it, fut, _t), sig in zip(batch, results):
             if not fut.done():
                 fut.set_result(sig)
 

@@ -223,14 +223,23 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
 
     m = sub.add_parser(
         "metrics",
-        help="one-shot Prometheus scrape of a replica's --metrics-port "
-        "endpoint (prints the exposition text)",
+        help="one-shot Prometheus scrape of replica --metrics-port "
+        "endpoints (one target: prints the exposition text; several: "
+        "per-target sections plus ONE merged cluster aggregate — the "
+        "log2 histograms merge exactly, counters sum)",
     )
     m.add_argument(
         "addr",
-        help="host:port (or full URL) of the replica's metrics endpoint",
+        nargs="+",
+        help="host:port (or full URL) of each replica's metrics endpoint",
     )
     m.add_argument("--timeout", type=float, default=5.0)
+    m.add_argument(
+        "--merged-only",
+        action="store_true",
+        help="with several targets: print only the merged cluster "
+        "aggregate, not the per-target sections",
+    )
 
     q = sub.add_parser("request", help="submit request(s) as a client")
     q.add_argument("ops", nargs="*", help="operations (default: stdin lines)")
@@ -437,11 +446,33 @@ async def _run_replica(args) -> int:
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
+    # SIGINT and SIGTERM both route through the clean-stop path, so the
+    # flight-recorder dump fires on ctrl-C exactly as on a managed stop.
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             loop.add_signal_handler(sig, stop.set)
         except NotImplementedError:  # non-Unix
             pass
+
+    def dump_engine_obs() -> None:
+        # Engine dispatcher spans + queue-wait histograms ride the
+        # shutdown dump alongside the replica's stage dump (no-op unless
+        # MINBFT_TRACE_DUMP is set — recorded events must land
+        # somewhere, not silently vanish).  The queue histograms feed
+        # the cluster critical-path merge (obs/critpath.py).
+        base = os.environ.get(obs_trace.TRACE_DUMP_ENV)
+        if engine is None or not base:
+            return
+        import json as _json
+
+        from ...obs import critpath as obs_critpath
+
+        doc = obs_critpath.engine_queue_doc(engine, ident=args.id)
+        events = engine.drain_obs_events()
+        if events:
+            doc["events"] = [list(e) for e in events]
+        with open(f"{base}.engine{args.id}.json", "w") as fh:
+            _json.dump(doc, fh)
 
     async def log_metrics() -> None:
         import json as _json
@@ -455,29 +486,27 @@ async def _run_replica(args) -> int:
     metrics_task = (
         loop.create_task(log_metrics()) if args.metrics_interval > 0 else None
     )
-    await stop.wait()
+    try:
+        await stop.wait()
+    except BaseException:
+        # Fatal error (or a cancellation unwinding the process): the
+        # trace must not die with it — a crashed run loses exactly the
+        # forensics that explain the crash.  Best-effort stop (which
+        # dumps) and engine-span dump, then let the error propagate.
+        print(f"replica {args.id} crashing: dumping trace", file=sys.stderr)
+        try:
+            await replica.stop()
+            dump_engine_obs()
+        except Exception:  # noqa: BLE001 - forensics must not mask the
+            pass  # original fatal error
+        raise
     if metrics_task is not None:
         metrics_task.cancel()
     print(f"replica {args.id} shutting down", file=sys.stderr)
     if metrics_server is not None:
         metrics_server.stop()
     await replica.stop()  # writes the replica's MINBFT_TRACE_DUMP file
-    if engine is not None:
-        # Engine dispatcher spans ride the shutdown dump alongside the
-        # replica's stage dump (no-op unless the ring was enabled and
-        # MINBFT_TRACE_DUMP is set — recorded events must land
-        # somewhere, not silently vanish).
-        events = engine.drain_obs_events()
-        base = os.environ.get("MINBFT_TRACE_DUMP")
-        if events and base:
-            import json as _json
-
-            with open(f"{base}.engine{args.id}.json", "w") as fh:
-                _json.dump(
-                    {"kind": "engine", "id": args.id,
-                     "events": [list(e) for e in events]},
-                    fh,
-                )
+    dump_engine_obs()
     await server.stop()
     await conn.close()
     return 0
@@ -912,17 +941,41 @@ def _run_testnet_scaffold(args) -> int:
 
 
 def _run_metrics_scrape(args) -> int:
-    """``peer metrics host:port`` — fetch and print one Prometheus
-    exposition from a running replica (synchronous: one GET, no event
-    loop)."""
-    from ...obs.prom import scrape
+    """``peer metrics host:port [host:port ...]`` — fetch and print
+    Prometheus expositions from running replicas (synchronous GETs, no
+    event loop).
 
-    try:
-        sys.stdout.write(scrape(args.addr, timeout=args.timeout))
-    except OSError as e:
-        print(f"peer: metrics scrape of {args.addr} failed: {e}", file=sys.stderr)
+    One target prints its exposition verbatim (the original contract).
+    Several targets print per-target sections and then ONE merged
+    cluster aggregate: the log2 histograms are exactly mergeable by
+    design (identical fixed bucket edges — obs/hist.py), counters sum,
+    and the per-process ``replica`` label is stripped so the same
+    logical series folds together.  A dead target costs its section
+    (and rc=1), never the others'."""
+    from ...obs.prom import merge_expositions, scrape
+
+    scraped: list = []
+    rc = 0
+    for addr in args.addr:
+        try:
+            scraped.append((addr, scrape(addr, timeout=args.timeout)))
+        except OSError as e:
+            print(
+                f"peer: metrics scrape of {addr} failed: {e}", file=sys.stderr
+            )
+            rc = 1
+    if not scraped:
         return 1
-    return 0
+    if len(args.addr) == 1:
+        sys.stdout.write(scraped[0][1])
+        return rc
+    if not args.merged_only:
+        for addr, text in scraped:
+            print(f"# ==== target {addr} ====")
+            sys.stdout.write(text)
+    print(f"# ==== merged cluster aggregate ({len(scraped)} targets) ====")
+    sys.stdout.write(merge_expositions(text for _, text in scraped))
+    return rc
 
 
 def main(argv=None) -> int:

@@ -99,6 +99,11 @@ class ReplicaMetrics:
         # The companion counters (ingest_ticks / ingest_frames) ride the
         # ordinary counter map so snapshot()/aggregate() carry them.
         self.ingest_hist = Log2CountHistogram()
+        # Event-loop scheduling lag (obs/looplag.py samples into this
+        # from the replica's loop): GIL/loop saturation, scraped as
+        # minbft_eventloop_lag_seconds and carried in trace dumps for
+        # the critical-path loop_lag segment.
+        self.loop_lag = Log2Histogram()
         self._started = time.monotonic()
 
     def inc(self, name: str, by: int = 1) -> None:

@@ -1,0 +1,167 @@
+"""Bench regression gate tests (tools/benchgate, ISSUE 8): pass /
+regression / stddev-band / relative-floor behavior, the hard
+tpu_unavailable refusal, missing-key handling, and the CLI exit codes
+CI relies on (0 pass, 1 regression, 2 refusal)."""
+
+import json
+
+import pytest
+
+from tools import benchgate
+from tools.benchgate import BackendMismatch, __main__ as benchgate_cli
+
+
+def _artifact(mean, stddev=0.0, prefix="e2e", backend="cpu",
+              tpu_unavailable=True, **extra):
+    art = {
+        "backend": backend,
+        f"{prefix}_req_per_sec_mean": mean,
+        f"{prefix}_req_per_sec_stddev": stddev,
+        f"{prefix}_req_per_sec_runs": [mean],
+        f"{prefix}_committed_req_per_sec": mean,
+    }
+    if tpu_unavailable:
+        art["tpu_unavailable"] = True
+    art.update(extra)
+    return art
+
+
+def test_identical_artifacts_pass():
+    base = _artifact(100.0, 5.0)
+    report = benchgate.compare(base, dict(base))
+    assert report.ok
+    assert report.results[0].status == "ok"
+    assert report.backend_kind == "cpu-fallback"
+
+
+def test_regression_beyond_both_bands_fails():
+    base = _artifact(100.0, 2.0)
+    cand = _artifact(60.0, 2.0)  # -40%: outside 3σ AND the 30% floor
+    report = benchgate.compare(base, cand)
+    assert not report.ok
+    r = report.results[0]
+    assert r.status == "regression" and r.drop == pytest.approx(40.0)
+
+
+def test_stddev_band_absorbs_noisy_drop():
+    """A drop inside sigmas*sqrt(σb²+σc²) is noise, not a regression —
+    the _runs/_mean/_stddev triples exist exactly for this judgment."""
+    base = _artifact(100.0, 15.0)
+    cand = _artifact(62.0, 15.0)  # drop 38 < 3*sqrt(450) ≈ 63.6
+    assert benchgate.compare(base, cand).ok
+    # tighten the band and the same drop regresses
+    assert not benchgate.compare(base, cand, sigmas=1.0, rel_floor=0.1).ok
+
+
+def test_relative_floor_covers_single_run_configs():
+    """runs=1 ⇒ stddev 0.0: without the relative floor every wiggle
+    would 'regress'.  A 20% drop passes at the default 30% floor; a
+    40% drop does not."""
+    base = _artifact(10.0, 0.0)
+    assert benchgate.compare(base, _artifact(8.0, 0.0)).ok
+    assert not benchgate.compare(base, _artifact(6.0, 0.0)).ok
+
+
+def test_improvement_is_not_a_regression():
+    report = benchgate.compare(_artifact(10.0), _artifact(30.0))
+    assert report.ok
+    assert report.results[0].status == "improved"
+
+
+def test_tpu_unavailable_refuses_real_tpu_baseline():
+    tpu_base = _artifact(1000.0, backend="tpu", tpu_unavailable=False)
+    cpu_cand = _artifact(5.0)
+    with pytest.raises(BackendMismatch):
+        benchgate.compare(tpu_base, cpu_cand)
+    # and symmetrically: a TPU candidate never gates against CPU numbers
+    with pytest.raises(BackendMismatch):
+        benchgate.compare(cpu_cand, tpu_base)
+
+
+def test_last_tpu_carry_forward_block_is_never_read():
+    """A CPU artifact embedding a last_tpu block stays a CPU artifact:
+    the nested chip numbers must neither flip the backend kind nor leak
+    into the gated key set."""
+    base = _artifact(5.0, last_tpu={
+        "extras": {"backend": "tpu", "e2e_req_per_sec_mean": 450.0},
+    })
+    cand = _artifact(5.0, last_tpu={
+        "extras": {"backend": "tpu", "e2e_req_per_sec_mean": 1.0},
+    })
+    report = benchgate.compare(base, cand)
+    assert report.ok
+    assert [r.key for r in report.results] == ["e2e"]
+    assert report.results[0].baseline == 5.0
+
+
+def test_missing_candidate_key_warns_by_default():
+    base = _artifact(100.0)
+    base.update(_artifact(50.0, prefix="mp"))
+    cand = _artifact(100.0)
+    report = benchgate.compare(base, cand)
+    assert report.ok
+    assert report.missing == ["mp"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(_artifact(100.0, 2.0)))
+
+    cand_p.write_text(json.dumps(_artifact(99.0, 2.0)))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 0
+    assert "benchgate: pass" in capsys.readouterr().out
+
+    cand_p.write_text(json.dumps(_artifact(40.0, 2.0)))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+    # refusal: backend-kind mismatch
+    cand_p.write_text(json.dumps(
+        _artifact(40.0, backend="tpu", tpu_unavailable=False)
+    ))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 2
+
+    # refusal: unreadable artifact
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(tmp_path / "nope")]
+    ) == 2
+
+    # refusal: nothing to gate
+    cand_p.write_text(json.dumps({"backend": "cpu", "tpu_unavailable": True}))
+    base2 = tmp_path / "empty.json"
+    base2.write_text(json.dumps({"backend": "cpu", "tpu_unavailable": True}))
+    assert benchgate_cli.main(
+        ["--baseline", str(base2), "--candidate", str(cand_p)]
+    ) == 2
+
+
+def test_cli_fail_on_missing_and_json_report(tmp_path, capsys):
+    base = _artifact(100.0)
+    base.update(_artifact(50.0, prefix="mp"))
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(base))
+    cand_p.write_text(json.dumps(_artifact(100.0)))
+    args = ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    assert benchgate_cli.main(args) == 0
+    capsys.readouterr()
+    assert benchgate_cli.main(args + ["--fail-on-missing"]) == 1
+    capsys.readouterr()
+    assert benchgate_cli.main(args + ["--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["missing"] == ["mp"]
+    assert doc["results"][0]["key"] == "e2e"
+
+
+def test_committed_artifacts_pass_the_default_gate():
+    """The acceptance wiring: the repo's own committed candidate and
+    baseline must gate green with the default thresholds (this is what
+    `make check` runs)."""
+    assert benchgate_cli.main([]) == 0

@@ -1,0 +1,338 @@
+"""Cluster critical-path tests (minbft_tpu/obs/critpath.py, ISSUE 8):
+synthetic multi-node dump fixtures with KNOWN per-process clock offsets
+and a fully hand-computed stage timeline — clockalign must recover the
+offsets within its own uncertainty (the Cristian RTT bound), and the
+critpath segment shares must match the ground truth the generator built
+the events from."""
+
+import json
+
+import pytest
+
+from minbft_tpu.obs import clockalign, critpath
+from minbft_tpu.obs.hist import Log2Histogram
+from minbft_tpu.obs.trace import CLIENT_STAGES, REPLICA_STAGES, load_dumps
+
+MS = 1_000_000  # ns
+
+# Ground-truth stage constants (ns).  The generator telescopes these
+# into event timestamps; the tests recompute expected segments from the
+# SAME names longhand, so a critpath formula drift shows as a diff
+# against constants, not against a reimplementation of itself.
+SIGN = 2 * MS
+GATE = MS // 10
+NET = [MS, MS * 11 // 10, MS * 12 // 10, MS * 13 // 10]  # per-replica one-way
+PREVERIFY = MS // 2
+VERIFY_SPAN = 3 * MS
+PREPARE_WAIT = 1 * MS
+CQ_WAIT = 2 * MS
+EXECUTE = MS // 10
+SIGN_SPAN = 4 * MS
+REPLY_SEND = MS // 5
+LAG_S = 0.0002  # 0.2 ms mean loop lag
+VR = 0.75  # verify queue wait ratio
+SR = 0.5  # sign queue wait ratio
+
+_R = {name: i for i, name in enumerate(REPLICA_STAGES)}
+_C = {name: i for i, name in enumerate(CLIENT_STAGES)}
+
+
+def synth_docs(n=4, f=1, n_req=12, client_id=7,
+               offsets=None, client_offset=0, domains=None,
+               client_domain="hostC"):
+    """Synthetic dump docs for an n-replica cluster and one client.
+
+    True-timeline construction (per request k, all on one ideal clock):
+    client start → +SIGN sign → +GATE broadcast; replica i receives at
+    +NET[i], verifies (+PREVERIFY, +VERIFY_SPAN); the primary (replica
+    0) applies the PREPARE +PREPARE_WAIT later, backups +NET[i] after
+    that; every replica's commit quorum lands +CQ_WAIT after its own
+    prepare, then +EXECUTE/+SIGN_SPAN/+REPLY_SEND; replies travel back
+    +NET[i]; the client's quorum note is the (f+1)-th reply arrival.
+    ``offsets[i]``/``client_offset`` shift each dump into its own local
+    clock; ``domains`` control whether alignment may assume a shared
+    clock (same string) or must estimate (distinct strings)."""
+    offsets = offsets or [0] * n
+    domains = domains or [f"host{i}" for i in range(n)]
+    lag = Log2Histogram()
+    lag.observe(LAG_S)
+
+    client_rows = []
+    replica_rows = {i: [] for i in range(n)}
+    truth = {}
+    for k in range(n_req):
+        t0 = 50 * MS * (k + 1)
+        sign = t0 + SIGN
+        bcast = sign + GATE
+        client_rows += [
+            [client_id, k, _C["start"], t0 + client_offset],
+            [client_id, k, _C["sign"], sign + client_offset],
+            [client_id, k, _C["broadcast"], bcast + client_offset],
+        ]
+        prep0 = bcast + NET[0] + PREVERIFY + VERIFY_SPAN + PREPARE_WAIT
+        arrivals = []
+        for i in range(n):
+            recv = bcast + NET[i]
+            venq = recv + PREVERIFY
+            vdone = venq + VERIFY_SPAN
+            prep = prep0 if i == 0 else prep0 + NET[i]
+            cq = prep + CQ_WAIT
+            exe = cq + EXECUTE
+            rsign = exe + SIGN_SPAN
+            rsent = rsign + REPLY_SEND
+            arrivals.append(rsent + NET[i])
+            off = offsets[i]
+            replica_rows[i] += [
+                [client_id, k, _R["recv"], recv + off],
+                [client_id, k, _R["verify_enqueue"], venq + off],
+                [client_id, k, _R["verify_done"], vdone + off],
+                [client_id, k, _R["prepare"], prep + off],
+                [client_id, k, _R["commit_quorum"], cq + off],
+                [client_id, k, _R["execute"], exe + off],
+                [client_id, k, _R["reply_sign"], rsign + off],
+                [client_id, k, _R["reply_sent"], rsent + off],
+            ]
+        quorum = sorted(arrivals)[f]  # (f+1)-th reply arrival
+        client_rows.append(
+            [client_id, k, _C["quorum"], quorum + client_offset]
+        )
+        truth[k] = {"t0": t0, "quorum": quorum}
+
+    docs = []
+    for i in range(n):
+        docs.append({
+            "kind": "replica", "id": i, "stages": list(REPLICA_STAGES),
+            "clock_domain": domains[i], "n": n, "f": f,
+            "loop_lag": lag.to_dict(), "events": replica_rows[i],
+        })
+    docs.append({
+        "kind": "client", "id": client_id, "stages": list(CLIENT_STAGES),
+        "clock_domain": client_domain, "events": client_rows,
+    })
+    # Engine doc with exact wait/service ratios (ratio = total_s based,
+    # so single observations pin it exactly).
+    vwait, vservice = Log2Histogram(), Log2Histogram()
+    vwait.observe(VR)
+    vservice.observe(1 - VR)
+    swait, sservice = Log2Histogram(), Log2Histogram()
+    swait.observe(SR)
+    sservice.observe(1 - SR)
+    docs.append({
+        "kind": "engine", "id": 0,
+        "verify_queue_wait": {"q": vwait.to_dict()},
+        "verify_queue_service": {"q": vservice.to_dict()},
+        "sign_queue_wait": {"s": swait.to_dict()},
+        "sign_queue_service": {"s": sservice.to_dict()},
+    })
+    return docs, truth
+
+
+def expected_segments():
+    """The hand-computed ground truth, longhand from the constants (the
+    rank-(f+1) tail with f=1 runs through replica 1 — NET is strictly
+    increasing, so replica i's whole tail chain is the i-th smallest)."""
+    lag_ns = LAG_S * 1e9
+    return {
+        "client_sign": SIGN,
+        "client_gate": GATE,
+        "ingress": NET[0] - lag_ns,
+        "loop_lag": lag_ns,
+        "preverify": PREVERIFY,
+        "queue_wait": VERIFY_SPAN * VR + SIGN_SPAN * SR,
+        "verify": VERIFY_SPAN * (1 - VR),
+        "prepare_wait": PREPARE_WAIT,
+        "commit": NET[1] + CQ_WAIT,
+        "execute": EXECUTE,
+        "reply_sign": SIGN_SPAN * (1 - SR),
+        "reply_send": REPLY_SEND,
+        "reply_net": NET[1],
+        "unattributed": 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# clockalign
+
+
+def test_same_domain_docs_align_exactly():
+    docs, _ = synth_docs(domains=["sharedhost"] * 4,
+                         client_domain="sharedhost")
+    al = clockalign.align(docs)
+    for i in range(4):
+        assert al[("replica", i)].offset_ns == 0.0
+        assert al[("replica", i)].err_ns == 0.0
+    assert al[("client", 7)].offset_ns == 0.0
+
+
+def test_alignment_recovers_injected_offsets_within_rtt_bound():
+    """Distinct clock domains with known injected offsets: the Cristian
+    estimate must land within its OWN reported uncertainty of the true
+    offset, and that uncertainty must stay within the round-trip bound
+    (one-way latencies here are ~1ms, so RTT-derived error can never
+    legitimately exceed a few ms)."""
+    offsets = [0, 250 * MS, -40 * MS, 7 * MS]
+    client_offset = 1000 * MS
+    docs, _ = synth_docs(offsets=offsets, client_offset=client_offset)
+    al = clockalign.align(docs)
+    # Reference timeline = replica 0's local clock (true + offsets[0]).
+    exact_client = offsets[0] - client_offset
+    got = al[("client", 7)]
+    assert abs(got.offset_ns - exact_client) <= got.err_ns + 1
+    assert 0 < got.err_ns <= 3 * MS  # the RTT bound
+    for i in range(1, 4):
+        exact = offsets[0] - offsets[i]
+        got = al[("replica", i)]
+        assert abs(got.offset_ns - exact) <= got.err_ns + 1, (i, got)
+        assert got.err_ns <= 2 * 3 * MS  # two estimated hops via the hub
+
+
+def test_pair_estimate_reports_inconsistent_bounds():
+    """Contaminated bounds (L > U) must surface as consistent=False with
+    an |U-L|/2 uncertainty, not crash or report false precision."""
+    cdoc = {
+        "kind": "client", "id": 0, "stages": list(CLIENT_STAGES),
+        "events": [
+            [0, 1, _C["broadcast"], 1000],
+            # quorum noted long BEFORE this replica's reply went out —
+            # the late-replier contamination shape.
+            [0, 1, _C["quorum"], 1500],
+        ],
+    }
+    rdoc = {
+        "kind": "replica", "id": 0, "stages": list(REPLICA_STAGES),
+        "events": [
+            [0, 1, _R["recv"], 1100],
+            [0, 1, _R["reply_sent"], 9000],
+        ],
+    }
+    est = clockalign.estimate_pair(cdoc, rdoc)
+    assert est is not None
+    assert not est.consistent
+    assert est.err_ns > 0
+
+
+# ---------------------------------------------------------------------------
+# critpath ground truth
+
+
+def test_critpath_shares_match_hand_computed_ground_truth(tmp_path):
+    """Same-clock cluster (one domain): the per-segment shares must
+    reproduce the generator's constants through the REAL dump→ingest
+    path (files on disk, load_dumps)."""
+    docs, truth = synth_docs(domains=["h"] * 4, client_domain="h")
+    base = str(tmp_path / "trace")
+    for d in docs:
+        tag = {"replica": "r", "client": "c", "engine": "engine"}[d["kind"]]
+        with open(f"{base}.{tag}{d['id']}.json", "w") as fh:
+            json.dump(d, fh)
+    loaded = load_dumps(base)
+    assert len(loaded) == 6
+
+    table = critpath.critpath_table(loaded, "t")
+    exp = expected_segments()
+    total = sum(v for k, v in exp.items())
+    # Telescoping check on the generator itself: the segment constants
+    # must reconstruct the client-observed total exactly.
+    k0 = next(iter(truth))
+    assert total == pytest.approx(
+        truth[k0]["quorum"] - truth[k0]["t0"], abs=1
+    )
+    for seg in critpath.SEGMENTS:
+        assert f"t_critpath_{seg}_share" in table, seg
+        assert table[f"t_critpath_{seg}_share"] == pytest.approx(
+            exp[seg] / total, abs=2e-3
+        ), seg
+    assert sum(
+        v for k, v in table.items() if k.endswith("_share")
+    ) == pytest.approx(1.0, abs=0.02)
+    assert table["t_critpath_requests"] == 12
+    assert table["t_critpath_skipped"] == 0
+    assert table["t_critpath_clock_err_ms"] == 0.0
+    assert table["t_critpath_total_p50_ms"] == pytest.approx(
+        total / 1e6, rel=0.01
+    )
+    assert "t_critpath_negative_spans" not in table
+
+
+def test_critpath_survives_injected_offsets():
+    """Cross-domain dumps with large injected offsets: shares must
+    still telescope to 1.0 and stay close to ground truth — the
+    alignment error is bounded by the (reported) RTT uncertainty."""
+    docs, _ = synth_docs(offsets=[0, 500 * MS, -300 * MS, 60 * MS],
+                         client_offset=-2000 * MS)
+    table = critpath.critpath_table(docs, "t")
+    assert table, "offsets must not make the merge give up"
+    assert sum(
+        v for k, v in table.items() if k.endswith("_share")
+    ) == pytest.approx(1.0, abs=0.02)
+    assert table["t_critpath_clock_err_ms"] > 0
+    exp = expected_segments()
+    total = sum(exp.values())
+    # Cross-node segments can shift by up to the alignment error; the
+    # error itself is ~1ms on a ~17ms path, so shares stay within a few
+    # points of truth.
+    err_share = table["t_critpath_clock_err_ms"] * 1e6 * 2 / total
+    for seg in ("commit", "reply_net", "queue_wait", "verify"):
+        assert table[f"t_critpath_{seg}_share"] == pytest.approx(
+            exp[seg] / total, abs=max(0.05, err_share)
+        ), seg
+
+
+def test_critpath_negative_spans_clock_sanity_flag():
+    docs, _ = synth_docs(domains=["h"] * 4, client_domain="h")
+    bad = Log2Histogram()
+    bad.observe(-0.5)
+    bad.observe(0.001)
+    docs[0]["hists"] = {"execute": bad.to_dict()}
+    table = critpath.critpath_table(docs, "t")
+    assert table["t_critpath_negative_spans"] == 1
+
+
+def test_critpath_empty_and_partial_dumps():
+    assert critpath.critpath_table([], "t") == {}
+    # replica-only dumps (no client anchor): no path, no keys
+    docs, _ = synth_docs()
+    replicas_only = [d for d in docs if d["kind"] == "replica"]
+    assert critpath.critpath_table(replicas_only, "t") == {}
+    # a request with a missing head is SKIPPED, not misattributed
+    docs, _ = synth_docs(domains=["h"] * 4, client_domain="h", n_req=4)
+    for d in docs:
+        if d["kind"] == "replica":
+            d["events"] = [
+                row for row in d["events"]
+                if not (row[1] == 0 and row[2] == _R["prepare"])
+            ]
+    res = critpath.cluster_paths(docs)
+    assert res.skipped == 1
+    assert len(res.paths) == 3
+
+
+def test_engine_queue_doc_round_trip():
+    """The live engine's queue histograms survive the doc round trip
+    and drive the wait-ratio split."""
+    import asyncio
+
+    from minbft_tpu.parallel import BatchVerifier
+
+    async def run():
+        eng = BatchVerifier(max_batch=8, buckets=(8,))
+        import hashlib
+        import hmac as hmac_mod
+
+        key, msg = b"\x01" * 32, b"\x02" * 32
+        good = hmac_mod.new(key, msg, hashlib.sha256).digest()
+        oks = await asyncio.gather(
+            *[eng.verify_hmac_sha256(key, msg, good) for _ in range(8)]
+        )
+        assert all(oks)
+        doc = critpath.engine_queue_doc(eng, ident=3)
+        assert doc["kind"] == "engine" and doc["id"] == 3
+        wait = doc["verify_queue_wait"]["hmac_sha256"]
+        service = doc["verify_queue_service"]["hmac_sha256"]
+        st = eng.stats["hmac_sha256"]
+        assert wait["count"] == st.items
+        assert service["count"] == st.items
+        ratio = critpath._wait_ratio([doc], "verify")
+        assert ratio is not None and 0.0 <= ratio <= 1.0
+
+    asyncio.run(run())

@@ -114,6 +114,17 @@ def test_metrics_endpoint_scrapes_a_committing_cluster():
         try:
             for i in range(3):
                 await asyncio.wait_for(client.request(b"scrape-%d" % i), 30)
+            # f+1 matching replies resolve the client before the LAST
+            # replica executes; replica 0 may be one of the stragglers —
+            # wait for its counter before scraping (the pre-existing
+            # flake this poll fixes fired under PYTHONDEVMODE's slower
+            # loop).
+            for _ in range(400):
+                if replicas[0].metrics.counters.get(
+                    "requests_executed", 0
+                ) >= 3:
+                    break
+                await asyncio.sleep(0.02)
 
             server = MetricsServer(
                 lambda: render_families(
@@ -159,6 +170,103 @@ def test_metrics_endpoint_scrapes_a_committing_cluster():
                 await r.stop()
 
     asyncio.run(run())
+
+
+def test_parse_and_merge_expositions():
+    """The scrape→parse→merge round trip (the `peer metrics` cluster
+    aggregate): histograms merge EXACTLY (per-le bucket counts add,
+    sparse grids union), counters sum, and the per-process replica
+    label is stripped so the same logical series folds together."""
+    from minbft_tpu.obs.prom import merge_expositions, parse_exposition
+
+    def exposition(replica, counter, samples):
+        h = Log2Histogram()
+        for v in samples:
+            h.observe(v)
+        return render_families([
+            ("minbft_requests_executed_total", "counter", "c",
+             [({"replica": str(replica)}, counter)]),
+            ("minbft_stage_latency_seconds", "histogram", "h",
+             [({"replica": str(replica), "stage": "execute"}, h)]),
+        ])
+
+    a_samples = [1e-6, 3e-6, 1e-3]
+    b_samples = [2e-6, 0.25]
+    merged = merge_expositions(
+        [exposition(0, 3, a_samples), exposition(1, 4, b_samples)]
+    )
+    fams = parse_exposition(merged)
+    assert fams["minbft_requests_executed_total"]["samples"][()] == 7
+    hist_fam = fams["minbft_stage_latency_seconds"]
+    (key, sample), = hist_fam["samples"].items()
+    assert dict(key) == {"stage": "execute"}  # replica label stripped
+    assert sample["count"] == 5
+    assert sample["sum"] == pytest.approx(sum(a_samples) + sum(b_samples))
+    # the merged cumulative counts equal a direct merge of the hists
+    both = Log2Histogram()
+    for v in a_samples + b_samples:
+        both.observe(v)
+    cum = 0
+    expected = {}
+    for i, c in enumerate(both.buckets):
+        cum += c
+        if c:
+            expected[both.bucket_upper_bounds_s()[i]] = cum
+    finite = {
+        le: c for le, c in sample["buckets"].items() if le != float("inf")
+    }
+    assert finite == expected
+
+
+def test_peer_metrics_multi_target_merges(capsys):
+    """`peer metrics a b` prints per-target sections plus one merged
+    cluster aggregate; --merged-only prints just the aggregate; a dead
+    target costs rc=1 but not the live targets' output."""
+    from minbft_tpu.sample.peer import cli
+
+    def server_for(replica, count):
+        return MetricsServer(
+            lambda: render_families([
+                ("minbft_requests_executed_total", "counter", "c",
+                 [({"replica": str(replica)}, count)]),
+            ]),
+            host="127.0.0.1",
+        )
+
+    s0, s1 = server_for(0, 3), server_for(1, 4)
+    p0, p1 = s0.start(), s1.start()
+    try:
+        rc = cli.main(["metrics", f"127.0.0.1:{p0}", f"127.0.0.1:{p1}"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert f"# ==== target 127.0.0.1:{p0} ====" in out
+        assert "merged cluster aggregate (2 targets)" in out
+        assert 'minbft_requests_executed_total{replica="0"} 3' in out
+        assert "\nminbft_requests_executed_total 7" in out
+
+        rc = cli.main([
+            "metrics", f"127.0.0.1:{p0}", f"127.0.0.1:{p1}", "--merged-only",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "==== target" not in out
+        assert "\nminbft_requests_executed_total 7" in out
+    finally:
+        s0.stop()
+        s1.stop()
+    # one target dead: the live one still prints, rc flags the failure
+    s2 = server_for(0, 5)
+    p2 = s2.start()
+    try:
+        rc = cli.main(
+            ["metrics", f"127.0.0.1:{p2}", f"127.0.0.1:{p1}",
+             "--timeout", "0.5"]
+        )
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert 'minbft_requests_executed_total{replica="0"} 5' in out
+    finally:
+        s2.stop()
 
 
 def test_peer_metrics_subcommand_scrapes(capsys):
@@ -255,8 +363,17 @@ def test_bench_keys_trace_enabled_adds_only_stage_keys():
     keys = _bench_cluster_keys(trace=True)
     extra = keys - _PINNED_BENCH_KEYS
     assert extra, "traced run must add stage keys"
-    assert all("pin_stage_" in k for k in extra), sorted(extra)
+    # a traced run adds exactly the per-stage attribution AND the
+    # cluster critical-path keys (ISSUE 8) — nothing else
+    assert all(
+        "pin_stage_" in k or "pin_critpath_" in k for k in extra
+    ), sorted(extra)
     # and the replica pipeline is fully attributed
     for name in ("verify_done", "commit_quorum", "execute", "reply_sent"):
         assert f"pin_stage_{name}_p50_ms" in keys
         assert f"pin_stage_{name}_share" in keys
+    # the critical path carries its full stable segment set
+    from minbft_tpu.obs.critpath import SEGMENTS
+
+    for seg in SEGMENTS:
+        assert f"pin_critpath_{seg}_share" in keys, seg

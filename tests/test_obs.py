@@ -141,6 +141,150 @@ def test_engine_worker_ring_records_dispatch_spans():
     asyncio.run(run())
 
 
+def test_engine_queue_wait_and_service_histograms():
+    """Queue-wait attribution (ISSUE 8): every successfully dispatched
+    item records one enqueue→dispatch wait and one dispatch→complete
+    service span — count == items — and both surface in the Prometheus
+    exposition."""
+    import hashlib
+    import hmac as hmac_mod
+
+    from minbft_tpu.obs.prom import collect_replica, render_families
+    from minbft_tpu.parallel import BatchVerifier
+
+    async def run():
+        eng = BatchVerifier(max_batch=4, buckets=(4,))
+        key, msg = b"\x01" * 32, b"\x02" * 32
+        good = hmac_mod.new(key, msg, hashlib.sha256).digest()
+        items = [(key, msg, good[:-1] + bytes([i])) for i in range(9)]
+        await asyncio.gather(*[eng.verify_hmac_sha256(*it) for it in items])
+        st = eng.stats["hmac_sha256"]
+        assert st.queue_wait.count == st.items == 9
+        assert st.queue_service.count == st.items
+        assert st.queue_wait.negatives == 0
+        assert st.queue_service.total_s > 0
+        # sign side mirrors it (host fallback on the CPU backend still
+        # flows through the queue — the spans are queue properties)
+        from minbft_tpu.utils import hostcrypto as hc
+
+        d, _ = hc.keygen()
+        await eng.sign_ecdsa_p256(d, hashlib.sha256(b"qw").digest())
+        sst = eng.sign_stats["ecdsa_p256"]
+        assert sst.queue_wait.count == sst.items == 1
+        assert sst.queue_service.count == 1
+        text = render_families(collect_replica(engine=eng))
+        assert "minbft_verify_queue_wait_seconds_bucket" in text
+        assert "minbft_verify_queue_service_seconds_count" in text
+        assert "minbft_sign_queue_wait_seconds_bucket" in text
+
+    asyncio.run(run())
+
+
+def test_loop_lag_sampler_records_blocking(monkeypatch):
+    """The event-loop lag sampler sees a deliberate loop block: the max
+    observed lag must be at least the blocked interval (minus one tick),
+    and stop() tears the task down."""
+    import time as time_mod
+
+    from minbft_tpu.obs.looplag import LoopLagSampler, maybe_sampler
+
+    async def run():
+        hist = Log2Histogram()
+        sampler = LoopLagSampler(hist, interval=0.01)
+        sampler.start()
+        await asyncio.sleep(0.05)  # healthy ticks
+        time_mod.sleep(0.08)  # block the loop (the GIL-saturation shape)
+        await asyncio.sleep(0.03)
+        sampler.stop()
+        await asyncio.sleep(0)  # let the cancellation land
+        assert hist.count >= 3
+        assert hist.negatives == 0
+        # one sample must carry the ~80ms block: p100 >= 32ms bucket
+        assert hist.percentile(100) >= 0.032
+        # and most ticks are healthy: p50 well under the block
+        assert hist.percentile(50) < 0.032
+
+    asyncio.run(run())
+    # env knob: 0 disables, garbage falls back to the default
+    monkeypatch.setenv("MINBFT_LOOPLAG_INTERVAL", "0")
+    assert maybe_sampler(Log2Histogram()) is None
+    monkeypatch.setenv("MINBFT_LOOPLAG_INTERVAL", "not-a-number")
+    assert maybe_sampler(Log2Histogram()) is not None
+    monkeypatch.delenv("MINBFT_LOOPLAG_INTERVAL")
+    s = maybe_sampler(Log2Histogram())
+    assert s is not None and s.interval == 0.05
+
+
+def test_replica_dump_carries_loop_lag_and_nf(tmp_path, monkeypatch):
+    """A replica's shutdown dump carries n/f and the sampled loop-lag
+    histogram — the critpath merge's quorum rank and loop_lag inputs."""
+    from conftest import make_cluster
+    from minbft_tpu.obs import trace as trace_mod
+    from minbft_tpu.sample.config import SimpleConfiger
+
+    async def run():
+        cfg = SimpleConfiger(
+            n=4, f=1, timeout_request=60.0, timeout_prepare=30.0
+        )
+        cfg.trace = True
+        replicas, _c_auths, _stubs, _ledgers = await make_cluster(4, 1, cfg=cfg)
+        await asyncio.sleep(0.12)  # let the lag samplers tick
+        monkeypatch.setenv(
+            trace_mod.TRACE_DUMP_ENV, str(tmp_path / "dump")
+        )
+        for r in replicas:
+            await r.stop()
+
+    asyncio.run(run())
+    docs = load_dumps(str(tmp_path / "dump"))
+    assert len(docs) == 4
+    for doc in docs:
+        assert doc["n"] == 4 and doc["f"] == 1
+        assert doc["clock_domain"]
+        lag = Log2Histogram.from_dict(doc["loop_lag"])
+        assert lag.count > 0
+
+
+def test_trace_dump_fires_on_fatal_task_crash(tmp_path, monkeypatch):
+    """A replica task dying with an exception dumps the trace at the
+    moment of death — a crashed soak must not lose its forensics (the
+    dump used to fire only on clean stop)."""
+    from conftest import make_cluster
+    from minbft_tpu.obs import trace as trace_mod
+    from minbft_tpu.sample.config import SimpleConfiger
+
+    monkeypatch.setenv(trace_mod.TRACE_DUMP_ENV, str(tmp_path / "crash"))
+
+    async def run():
+        cfg = SimpleConfiger(
+            n=4, f=1, timeout_request=60.0, timeout_prepare=30.0
+        )
+        cfg.trace = True
+        replicas, _c, _stubs, _ledgers = await make_cluster(4, 1, cfg=cfg)
+        try:
+            replicas[0].handlers.trace.note(1, 9, 9)  # something to dump
+            # Kill one protocol task the way a real bug would: make it
+            # raise, then let the done-callback observe the corpse.
+            victim = replicas[0]._tasks[0]
+            victim.cancel()  # unwind it...
+            await asyncio.sleep(0)
+
+            async def boom():
+                raise RuntimeError("injected fatal task error")
+
+            t = asyncio.get_running_loop().create_task(boom())
+            t.add_done_callback(replicas[0]._on_task_done)
+            await asyncio.sleep(0.05)
+            assert os.path.exists(str(tmp_path / "crash") + ".r0.json")
+        finally:
+            for r in replicas:
+                await r.stop()
+
+    asyncio.run(run())
+    docs = load_dumps(str(tmp_path / "crash"))
+    assert any(d["kind"] == "replica" and d["id"] == 0 for d in docs)
+
+
 def test_engine_flush_reasons_and_occupancy_sum_to_batches():
     from minbft_tpu.parallel import BatchVerifier
 
@@ -183,8 +327,43 @@ def test_log2_histogram_bucket_edges():
     h.observe(3e-6)     # bucket 2 (2 < 3 <= 4)
     assert h.buckets[0] == 2 and h.buckets[1] == 1 and h.buckets[2] == 1
     assert h.count == 4
-    h.observe(-1.0)  # clock weirdness clamps, never corrupts
-    assert h.buckets[0] == 3
+
+
+def test_log2_histogram_counts_negative_durations():
+    """Clock weirdness is COUNTED, never silently clamped (ISSUE 8): a
+    negative duration lands in ``negatives`` only — buckets, count, and
+    total stay unpolluted — and the counter rides merge, the dump round
+    trip, and the Prometheus exposition."""
+    h = Log2Histogram()
+    h.observe(1e-6)
+    h.observe(-1.0)
+    h.observe_ns(-5)
+    assert h.negatives == 2
+    assert h.count == 1 and h.buckets[0] == 1
+    assert h.total_s == pytest.approx(1e-6)
+
+    other = Log2Histogram()
+    other.observe(-2.0)
+    h.merge(other)
+    assert h.negatives == 3
+
+    d = json.loads(json.dumps(h.to_dict()))
+    assert Log2Histogram.from_dict(d).negatives == 3
+    clean = Log2Histogram()
+    clean.observe(1e-3)
+    assert "negatives" not in clean.to_dict()  # sparse: only when nonzero
+
+    from minbft_tpu.obs.prom import render_families
+
+    text = render_families(
+        [("lat_seconds", "histogram", "x", [({"stage": "s"}, h)])]
+    )
+    assert 'lat_seconds_negatives_total{stage="s"} 3' in text
+    assert "# TYPE lat_seconds_negatives_total counter" in text
+    clean_text = render_families(
+        [("lat_seconds", "histogram", "x", [({"stage": "s"}, clean)])]
+    )
+    assert "negatives" not in clean_text
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2])

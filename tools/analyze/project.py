@@ -222,7 +222,10 @@ def default_config() -> AnalyzeConfig:
                 path="minbft_tpu/utils/metrics.py",
                 cls="ReplicaMetrics",
                 locks=(),
-                guarded=("counters", "ingest_hist"),
+                # loop_lag: written only by the replica's LoopLagSampler
+                # task (obs/looplag.py) on the owning loop; scrape
+                # threads read GIL-atomic ints.
+                guarded=("counters", "ingest_hist", "loop_lag"),
             ),
             # The batching engine is the one place real threads touch
             # shared state (dispatchers run via asyncio.to_thread):

@@ -1,0 +1,150 @@
+"""Bench regression gate: compare a candidate bench artifact against a
+committed baseline using the ``_runs``/``_mean``/``_stddev`` triples the
+bench harness emits (PR 4 added them for variance hygiene; this tool is
+their first consumer).
+
+Scope — deliberately narrow and honest:
+
+- Gated keys are EXACTLY the ``*_req_per_sec_mean`` triples present in
+  BOTH artifacts (the committed-throughput headlines; kernel rates have
+  no stddev companion and single-run phases carry stddev 0.0, which the
+  relative noise floor below absorbs).
+- A key regresses when its drop exceeds BOTH noise defenses:
+  ``drop > max(sigmas * sqrt(base_std² + cand_std²),
+  rel_floor * base_mean)`` — the stddev band covers measured run-to-run
+  variance, the relative floor covers the 1-core bench host's
+  documented ±30% single-run swing (perf/PROFILE_r05.md) when runs=1
+  makes the stddev lie at 0.
+- Backend honesty is a HARD refusal, not a threshold: a
+  ``tpu_unavailable`` (CPU-fallback) artifact can gate only against a
+  CPU baseline and vice versa — comparing CPU throughput against chip
+  throughput is not a regression check, it is a category error (the
+  standing VERDICT r5 caution).  Nested ``last_tpu`` carry-forward
+  blocks are never read: second-hand numbers gate nothing.
+
+Exit codes (``python -m tools.benchgate``): 0 pass, 1 regression,
+2 refusal/usage error — CI treats each differently (a refusal in CI is
+a wiring bug, not a perf regression).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Tuple
+
+DEFAULT_SIGMAS = 3.0
+DEFAULT_REL_FLOOR = 0.30
+
+_MEAN_SUFFIX = "_req_per_sec_mean"
+_STD_SUFFIX = "_req_per_sec_stddev"
+
+
+class BackendMismatch(Exception):
+    """Candidate and baseline artifacts ran on different backend kinds —
+    the comparison is refused, never softened into a threshold."""
+
+
+@dataclasses.dataclass
+class KeyResult:
+    key: str  # the config prefix (e.g. "e2e", "mptcp")
+    baseline: float
+    candidate: float
+    drop: float  # baseline - candidate (positive = slower)
+    allowed: float  # the noise allowance the drop is judged against
+    status: str  # "ok" | "regression" | "improved"
+
+
+@dataclasses.dataclass
+class GateReport:
+    results: List[KeyResult]
+    missing: List[str]  # gated keys in the baseline absent from candidate
+    backend_kind: str
+
+    @property
+    def regressions(self) -> List[KeyResult]:
+        return [r for r in self.results if r.status == "regression"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def backend_kind(artifact: dict) -> str:
+    """The honesty class of an artifact: ``cpu-fallback`` when stamped
+    ``tpu_unavailable`` (regardless of what its carried-forward blocks
+    say), else the recorded backend."""
+    if artifact.get("tpu_unavailable"):
+        return "cpu-fallback"
+    return str(artifact.get("backend", "unknown"))
+
+
+def gated_pairs(
+    baseline: dict, candidate: dict
+) -> Tuple[Dict[str, str], List[str]]:
+    """``{prefix: mean_key}`` for every triple present in both
+    artifacts, plus the prefixes the candidate dropped."""
+    pairs: Dict[str, str] = {}
+    missing: List[str] = []
+    for key in sorted(baseline):
+        if not key.endswith(_MEAN_SUFFIX):
+            continue
+        prefix = key[: -len(_MEAN_SUFFIX)]
+        if key in candidate:
+            pairs[prefix] = key
+        else:
+            missing.append(prefix)
+    return pairs, missing
+
+
+def compare(
+    baseline: dict,
+    candidate: dict,
+    sigmas: float = DEFAULT_SIGMAS,
+    rel_floor: float = DEFAULT_REL_FLOOR,
+) -> GateReport:
+    """Gate ``candidate`` against ``baseline``.  Raises
+    :class:`BackendMismatch` before reading a single number when the
+    artifacts' backend kinds differ."""
+    bk, ck = backend_kind(baseline), backend_kind(candidate)
+    if bk != ck:
+        raise BackendMismatch(
+            f"baseline is {bk!r} but candidate is {ck!r}: CPU artifacts "
+            "gate only against CPU baselines (tpu_unavailable caution); "
+            "re-baseline on the candidate's backend instead"
+        )
+    pairs, missing = gated_pairs(baseline, candidate)
+    results: List[KeyResult] = []
+    for prefix, mean_key in pairs.items():
+        base_mean = float(baseline[mean_key])
+        cand_mean = float(candidate[mean_key])
+        base_std = float(baseline.get(prefix + _STD_SUFFIX, 0.0))
+        cand_std = float(candidate.get(prefix + _STD_SUFFIX, 0.0))
+        drop = base_mean - cand_mean
+        allowed = max(
+            sigmas * math.sqrt(base_std**2 + cand_std**2),
+            rel_floor * base_mean,
+        )
+        if drop > allowed:
+            status = "regression"
+        elif drop < 0:
+            status = "improved"
+        else:
+            status = "ok"
+        results.append(
+            KeyResult(
+                key=prefix,
+                baseline=base_mean,
+                candidate=cand_mean,
+                drop=drop,
+                allowed=allowed,
+                status=status,
+            )
+        )
+    return GateReport(results=results, missing=missing, backend_kind=ck)

@@ -1,0 +1,133 @@
+"""``python -m tools.benchgate`` — the bench regression gate CLI.
+
+Defaults compare the working tree's ``BENCH_extras.json`` (the artifact
+the bench driver regenerates every round) against the committed
+``perf/BENCH_baseline.json``.  Wired into ``make check`` and CI; the CI
+smoke step additionally proves liveness by requiring a nonzero exit on
+an injected synthetic regression (a gate that cannot fail is not a
+gate).
+
+Exit codes: 0 pass, 1 regression detected, 2 refusal (backend-kind
+mismatch, unreadable artifact, or no gateable keys).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    DEFAULT_REL_FLOOR,
+    DEFAULT_SIGMAS,
+    BackendMismatch,
+    compare,
+    load_artifact,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="benchgate",
+        description="gate a bench artifact against a committed baseline "
+        "(stddev-aware, backend-kind-honest)",
+    )
+    p.add_argument(
+        "--candidate",
+        default=os.path.join(_REPO, "BENCH_extras.json"),
+        help="candidate bench artifact (default: BENCH_extras.json)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=os.path.join(_REPO, "perf", "BENCH_baseline.json"),
+        help="committed baseline artifact "
+        "(default: perf/BENCH_baseline.json)",
+    )
+    p.add_argument(
+        "--sigmas",
+        type=float,
+        default=DEFAULT_SIGMAS,
+        help="stddev multiplier for the noise band (default 3.0)",
+    )
+    p.add_argument(
+        "--rel-floor",
+        type=float,
+        default=DEFAULT_REL_FLOOR,
+        help="relative drop always tolerated, covering single-run "
+        "configs whose stddev is 0 (default 0.30 — the 1-core host's "
+        "documented swing)",
+    )
+    p.add_argument(
+        "--fail-on-missing",
+        action="store_true",
+        help="treat a gated key present in the baseline but absent from "
+        "the candidate as a regression (default: warn only — configs "
+        "are legitimately skipped on some backends)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the machine-readable report instead of the table",
+    )
+    args = p.parse_args(argv)
+
+    try:
+        baseline = load_artifact(args.baseline)
+        candidate = load_artifact(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"benchgate: cannot load artifact: {e}", file=sys.stderr)
+        return 2
+    try:
+        report = compare(
+            baseline, candidate, sigmas=args.sigmas, rel_floor=args.rel_floor
+        )
+    except BackendMismatch as e:
+        print(f"benchgate: REFUSED: {e}", file=sys.stderr)
+        return 2
+    if not report.results and not report.missing:
+        print(
+            "benchgate: no *_req_per_sec_mean triples shared by the two "
+            "artifacts — nothing to gate",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "backend_kind": report.backend_kind,
+                    "missing": report.missing,
+                    "results": [vars(r) for r in report.results],
+                    "ok": report.ok,
+                }
+            )
+        )
+    else:
+        print(f"benchgate: backend kind {report.backend_kind!r}, "
+              f"{len(report.results)} gated config(s)")
+        for r in report.results:
+            arrow = {"regression": "REGRESSION", "improved": "improved",
+                     "ok": "ok"}[r.status]
+            print(
+                f"  {r.key:12s} {r.baseline:10.1f} -> {r.candidate:10.1f} "
+                f"req/s  drop {r.drop:+.1f} vs allowed {r.allowed:.1f}  "
+                f"[{arrow}]"
+            )
+        for prefix in report.missing:
+            print(f"  {prefix:12s} present in baseline, MISSING from "
+                  "candidate" + (" [regression]" if args.fail_on_missing
+                                 else " [warn]"))
+    if report.regressions or (args.fail_on_missing and report.missing):
+        print("benchgate: FAIL", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("benchgate: pass")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
