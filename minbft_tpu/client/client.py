@@ -124,12 +124,17 @@ class Client:
         max_inflight: Optional[int] = None,
         retransmit_interval: Optional[float] = None,
         trace: bool = False,
+        group: Optional[int] = None,
     ):
         if n < 2 * f + 1:
             raise ValueError(f"n must be at least 2f+1 (n={n}, f={f})")
         self.client_id = client_id
         self.n = n
         self.f = f
+        # Consensus-group id when this is one of a MultiGroupClient's
+        # per-group inner clients (minbft_tpu/groups): labels the flight
+        # recorder so grouped dumps stay separable; None = ungrouped.
+        self.group = group
         self._auth = authenticator
         self._connector = connector
         # Sequence numbers seeded from wall clock so a restarted client
@@ -152,7 +157,7 @@ class Client:
         # first-reply → f+1-quorum); one predicated check per hook when
         # off (obs/trace.py).
         self._trace = (
-            obs_trace.FlightRecorder.for_client(client_id)
+            obs_trace.FlightRecorder.for_client(client_id, group=group)
             if (trace or obs_trace.tracing_enabled())
             else None
         )

@@ -151,10 +151,16 @@ class Handlers:
         unicast_logs: Dict[int, MessageLog],
         client_states: ClientStates,
         logger: Optional[logging.Logger] = None,
+        group: Optional[int] = None,
     ):
         self.replica_id = replica_id
         self.n = n
         self.f = f
+        # Consensus-group id when this core is one of a GroupRuntime's G
+        # instances (minbft_tpu/groups): pure observability — it labels
+        # the metrics and the flight recorder so per-group cost tables
+        # and Prometheus series stay separable on shared transport.
+        self.group = group
         self.configer = configer
         self.authenticator = authenticator
         self.consumer = consumer
@@ -176,7 +182,7 @@ class Handlers:
         # (in_transition gates our own sends).  O(n) ints, never pruned.
         self._peer_vc_bar: Dict[int, int] = {}
         self._ui_lock = asyncio.Lock()
-        self.metrics = ReplicaMetrics()
+        self.metrics = ReplicaMetrics(group=group)
         # Flight recorder (obs/trace.py): per-request stage spans into a
         # preallocated ring + per-stage histograms.  None unless the
         # operator opted in (configer.trace, or the MINBFT_TRACE /
@@ -184,7 +190,7 @@ class Handlers:
         # predicated attribute check (`if tr is not None`), the ISSUE's
         # disabled-cost contract.
         self.trace = (
-            obs_trace.FlightRecorder.for_replica(replica_id)
+            obs_trace.FlightRecorder.for_replica(replica_id, group=group)
             if (getattr(configer, "trace", False) or obs_trace.tracing_enabled())
             else None
         )
@@ -1779,6 +1785,35 @@ class _ConcurrentStreamProcessor:
         task = asyncio.get_running_loop().create_task(self._run(None, msg))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
+
+    async def try_submit_msg(self, msg: Message) -> bool:
+        """Non-blocking :meth:`submit_msg`: False when the concurrency
+        bound is exhausted instead of awaiting a slot.  The grouped
+        client drain (minbft_tpu/groups) uses this so ONE saturated
+        group's processor sheds ITS OWN messages — client retransmission
+        heals the loss — rather than head-of-line blocking every other
+        group's traffic on the shared stream (the same drop-on-full
+        isolation contract as the transport's per-group rx queues).
+        The locked() probe and the acquire are loop-atomic: with a free
+        slot, Semaphore.acquire returns without suspending."""
+        if self._sem.locked():
+            return False
+        await self._sem.acquire()
+        task = asyncio.get_running_loop().create_task(self._run(None, msg))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return True
+
+    async def try_submit(self, data: bytes) -> bool:
+        """Non-blocking :meth:`submit` (the grouped per-frame fallback
+        path's variant of :meth:`try_submit_msg`)."""
+        if self._sem.locked():
+            return False
+        await self._sem.acquire()
+        task = asyncio.get_running_loop().create_task(self._run(data, None))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return True
 
     async def _run(self, data: Optional[bytes], msg: Optional[Message]) -> None:
         try:

@@ -34,6 +34,7 @@ class _Replica(api.Replica):
         consumer: api.RequestConsumer,
         timer_provider: Optional[TimerProvider] = None,
         logger: Optional[logging.Logger] = None,
+        group: Optional[int] = None,
     ):
         n, f = configer.n, configer.f
         if n < 2 * f + 1:
@@ -44,6 +45,7 @@ class _Replica(api.Replica):
         self.id = replica_id
         self.n = n
         self.f = f
+        self.group = group
         self._connector = connector
         self._done = asyncio.Event()
         self._tasks: list = []
@@ -65,6 +67,7 @@ class _Replica(api.Replica):
             unicast_logs,
             client_states,
             logger or make_logger(replica_id),
+            group=group,
         )
 
     @property
@@ -122,11 +125,14 @@ class _Replica(api.Replica):
         """Cluster-merge context carried in this replica's trace dump:
         n/f (the critpath quorum rank) and the sampled loop-lag
         histogram (the critpath loop_lag segment)."""
-        return {
+        extra = {
             "n": self.n,
             "f": self.f,
             "loop_lag": self.handlers.metrics.loop_lag.to_dict(),
         }
+        if self.group is not None:
+            extra["group"] = self.group
+        return extra
 
     def dump_trace(self, base=None):
         """Write this replica's flight-recorder dump (None when tracing
@@ -178,6 +184,7 @@ def new_replica(
     timer_provider: Optional[TimerProvider] = None,
     logger: Optional[logging.Logger] = None,
     opts=None,
+    group: Optional[int] = None,
 ) -> api.Replica:
     """Create a replica (reference minbft.New, core/replica.go:50).
 
@@ -193,5 +200,6 @@ def new_replica(
         timer_provider = timer_provider or resolved.timer_provider
         logger = logger or resolved.logger
     return _Replica(
-        replica_id, configer, authenticator, connector, consumer, timer_provider, logger
+        replica_id, configer, authenticator, connector, consumer,
+        timer_provider, logger, group=group,
     )

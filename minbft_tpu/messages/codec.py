@@ -61,6 +61,15 @@ _TAG_SNAPSHOT_RESP = 0x0C
 # from the message tags — a multi frame is framing, not a message, and
 # never nests.
 _TAG_MULTI = 0xF0
+# Transport-level group envelope (the multi-group runtime's demux tag,
+# minbft_tpu/groups): [0xF1][u16 group id][inner frame].  Framing, not a
+# message — it wraps exactly one message frame (or one multi container on
+# the mux's physical hop), is stripped before decode, and NEVER nests.
+# An untagged frame is group 0 by definition, so a single-group runtime's
+# wire format is byte-identical to the ungrouped one.
+_TAG_GROUP = 0xF1
+_U16 = struct.Struct(">H")
+GROUP_MAX = 0xFFFF
 
 _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
@@ -702,6 +711,69 @@ def split_multi(data: bytes):
     if off != len(data):
         raise CodecError("trailing bytes in multi frame")
     return frames
+
+
+def pack_group(gid: int, frame: bytes) -> bytes:
+    """Wrap one wire frame in the group envelope.  Group 0 stays BARE —
+    the untagged encoding IS group 0 (single-group wire compatibility),
+    and keeping one canonical encoding per (gid, frame) means the demux
+    never has to dedup tagged-vs-untagged spellings of the same frame."""
+    if gid == 0:
+        return frame
+    if not 0 < gid <= GROUP_MAX:
+        raise CodecError(f"group id out of range: {gid}")
+    return bytes([_TAG_GROUP]) + _U16.pack(gid) + frame
+
+
+def split_group(frame: bytes):
+    """Inverse of :func:`pack_group`: ``(gid, inner frame)``.  Untagged
+    frames are group 0; a truncated envelope raises like any bad wire
+    bytes."""
+    if not frame or frame[0] != _TAG_GROUP:
+        return 0, frame
+    if len(frame) < 3:
+        raise CodecError("truncated group envelope")
+    return _U16.unpack_from(frame, 1)[0], frame[3:]
+
+
+def split_group_batch(frames):
+    """Whole-bundle group demux: ``[(gid, inner), ...]`` — the grouped
+    ingest tick's classification stage.  Large bundles classify the
+    envelope tag with one numpy gather over the concatenated frames
+    (the same trick :func:`unmarshal_batch` uses for message tags);
+    malformed envelopes become item-wise ``CodecError`` VALUES in the
+    gid slot (``(err, frame)``) so one bad frame cannot poison the
+    bundle."""
+    n = len(frames)
+    out = []
+    if n < _BATCH_MIN:
+        for fr in frames:
+            try:
+                out.append(split_group(fr))
+            except CodecError as e:
+                out.append((e, fr))
+        return out
+    lens = np.fromiter((len(fr) for fr in frames), dtype=np.int64, count=n)
+    buf = b"".join(frames) + b"\x00" * 3
+    arr = np.frombuffer(buf, dtype=np.uint8)
+    offs = np.zeros(n, dtype=np.int64)
+    np.cumsum(lens[:-1], out=offs[1:])
+    tags = np.where(lens > 0, arr[offs], -1)
+    grouped = tags == _TAG_GROUP
+    gids = np.where(
+        grouped & (lens >= 3), _gather_be(arr, offs + 1, 2), 0
+    ).astype(np.int64)
+    grouped_l = grouped.tolist()
+    gids_l = gids.tolist()
+    lens_l = lens.tolist()
+    for i, fr in enumerate(frames):
+        if not grouped_l[i]:
+            out.append((0, fr))
+        elif lens_l[i] < 3:
+            out.append((CodecError("truncated group envelope"), fr))
+        else:
+            out.append((gids_l[i], fr[3:]))
+    return out
 
 
 # Coalescing bounds shared by every stream pump: one frame can neither

@@ -192,6 +192,33 @@ def cluster_paths(docs: Iterable[dict], quorum: Optional[int] = None) -> Cluster
     bound for the dumped replica count.
     """
     docs = list(docs)
+    groups = {d["group"] for d in docs if d.get("group") is not None}
+    if len(groups) > 1:
+        # Multi-group dump set (a GroupRuntime process dumps every core,
+        # a MultiGroupClient every inner client): (client_id, seq) is
+        # only unique WITHIN a group — the G inner clients share one
+        # client id with wall-clock-seeded seq spaces that can overlap —
+        # so stitch each group's docs separately (unstamped docs like
+        # the shared engine's stay in every partition, exactly the
+        # filter_group contract) and fold the results.
+        from .trace import filter_group
+
+        merged: Optional[ClusterPaths] = None
+        for g in sorted(groups):
+            res = cluster_paths(filter_group(docs, g), quorum=quorum)
+            if merged is None:
+                merged = res
+            else:
+                merged.paths.extend(res.paths)
+                merged.skipped += res.skipped
+                merged.clock_err_ns = max(
+                    merged.clock_err_ns, res.clock_err_ns
+                )
+        assert merged is not None
+        # Unstamped docs rode every partition: recount their
+        # negative-span tallies exactly once over the full set.
+        merged.negative_spans = sum(_doc_negatives(d) for d in docs)
+        return merged
     replica_docs = [d for d in docs if d.get("kind") == "replica"]
     client_docs = [d for d in docs if d.get("kind") == "client"]
     engine_docs = [d for d in docs if d.get("kind") == "engine"]
@@ -362,7 +389,10 @@ def _one_path(
 
 
 def critpath_table(
-    docs: Iterable[dict], prefix: str, quorum: Optional[int] = None
+    docs: Iterable[dict],
+    prefix: str,
+    quorum: Optional[int] = None,
+    group: Optional[int] = None,
 ) -> dict:
     """The bench's cluster critical-path keys (the ``stage_table``
     sibling): ``{prefix}_critpath_{segment}_share`` for EVERY segment in
@@ -371,10 +401,16 @@ def critpath_table(
     plus request count, total p50, the clock-uncertainty bound, and —
     only when nonzero — the negative-span clock-sanity counter.
 
+    ``group`` restricts the merge to one consensus group's recorders
+    (multi-group runtime dumps; :func:`minbft_tpu.obs.trace.filter_group`
+    semantics — unstamped docs like the shared engine's stay in).
+
     Returns {} when the dumps yield no complete request, so a
     tracing-disabled bench emits byte-identical keys to a tracing-absent
     one (the stage_table contract)."""
-    res = cluster_paths(docs, quorum=quorum)
+    from .trace import filter_group
+
+    res = cluster_paths(filter_group(docs, group), quorum=quorum)
     if not res.paths:
         return {}
     grand = sum(p.total_ns for p in res.paths)

@@ -116,6 +116,7 @@ def collect_replica(
     recorder=None,
     engine=None,
     replica_id: Optional[int] = None,
+    group: Optional[int] = None,
 ) -> List[Family]:
     """Build the metric families for one replica process.
 
@@ -124,8 +125,20 @@ def collect_replica(
     None when tracing is off — the stage families simply vanish), and
     ``engine`` a :class:`minbft_tpu.parallel.BatchVerifier` (or None
     for ``--no-batch`` replicas).
+
+    ``group`` labels every family with the consensus-group id (the
+    multi-group runtime calls this once per group core; metrics that
+    carry their own ``ReplicaMetrics.group`` stamp win when the caller
+    passes none).  Merged scrapes stay group-separable: ``peer
+    metrics``' cluster aggregate strips only the per-process ``replica``
+    label, so the same group's series fold across replicas while
+    distinct groups never merge.
     """
+    if group is None and metrics is not None:
+        group = getattr(metrics, "group", None)
     base = {} if replica_id is None else {"replica": str(replica_id)}
+    if group is not None:
+        base["group"] = str(group)
     fams: List[Family] = []
     if metrics is not None:
         # dict(...) snapshots the counter map once: the loop may insert
@@ -197,6 +210,47 @@ def collect_replica(
     if engine is not None:
         fams.extend(_collect_engine(engine, base))
     return fams
+
+
+def merge_family_lists(lists: Iterable[List[Family]]) -> List[Family]:
+    """Fold several family lists into one exposition-valid list: a
+    family name may appear only once per exposition, so per-group
+    ``collect_replica`` outputs (multi-group runtime — same families,
+    distinct ``group`` labels) concatenate their SAMPLES under one
+    family block instead of repeating the block."""
+    merged: Dict[str, list] = {}
+    order: List[str] = []
+    for fams in lists:
+        for name, mtype, help_text, samples in fams:
+            ent = merged.get(name)
+            if ent is None:
+                merged[name] = [mtype, help_text, list(samples)]
+                order.append(name)
+            else:
+                ent[2].extend(samples)
+    return [
+        (name, merged[name][0], merged[name][1], merged[name][2])
+        for name in order
+    ]
+
+
+def collect_group_runtime(runtime, engine=None, replica_id=None) -> List[Family]:
+    """Families for a :class:`minbft_tpu.groups.GroupRuntime`: one
+    ``collect_replica`` per group core (every series carries its
+    ``group`` label), the shared engine's families once (its queues
+    really are shared — splitting them per group would double-count)."""
+    lists = [
+        collect_replica(
+            metrics=core.metrics,
+            recorder=core.handlers.trace,
+            replica_id=replica_id,
+            group=core.group,
+        )
+        for core in runtime.cores
+    ]
+    if engine is not None:
+        lists.append(collect_replica(engine=engine, replica_id=replica_id))
+    return merge_family_lists(lists)
 
 
 def collect_faultnet(census, base: Optional[Dict[str, str]] = None) -> List[Family]:

@@ -208,11 +208,16 @@ class FlightRecorder:
         stages: Tuple[str, ...],
         ring_capacity: Optional[int] = None,
         entry_stages: Optional[frozenset] = None,
+        group: Optional[int] = None,
     ):
         if ring_capacity is None:
             ring_capacity = int(os.environ.get(_RING_ENV, _DEFAULT_RING))
         self.kind = kind  # "replica" | "client" | "engine"
         self.ident = ident
+        # Consensus-group id (multi-group runtime): stamped into dumps so
+        # stage_table/critpath_table can filter one group's spans out of
+        # a shared-process dump set; None = ungrouped.
+        self.group = group
         self.stages = stages
         self.ring = StageRing(ring_capacity)
         self.hists: List[Log2Histogram] = [Log2Histogram() for _ in stages]
@@ -225,17 +230,22 @@ class FlightRecorder:
         self._last: Dict[Tuple[int, int], int] = {}
 
     @staticmethod
-    def for_replica(replica_id: int) -> "FlightRecorder":
+    def for_replica(
+        replica_id: int, group: Optional[int] = None
+    ) -> "FlightRecorder":
         return FlightRecorder(
             "replica",
             replica_id,
             REPLICA_STAGES,
             entry_stages=_REPLICA_ENTRY_STAGES,
+            group=group,
         )
 
     @staticmethod
-    def for_client(client_id: int) -> "FlightRecorder":
-        return FlightRecorder("client", client_id, CLIENT_STAGES)
+    def for_client(
+        client_id: int, group: Optional[int] = None
+    ) -> "FlightRecorder":
+        return FlightRecorder("client", client_id, CLIENT_STAGES, group=group)
 
     def note(self, stage: int, cid: int, seq: int) -> None:
         t = time.monotonic_ns()
@@ -271,7 +281,7 @@ class FlightRecorder:
         }
 
     def to_dict(self, max_events: int = 4096) -> dict:
-        return {
+        doc = {
             "kind": self.kind,
             "id": self.ident,
             "stages": list(self.stages),
@@ -281,6 +291,9 @@ class FlightRecorder:
                 list(e) for e in self.ring.snapshot(limit=max_events)
             ],
         }
+        if self.group is not None:
+            doc["group"] = self.group
+        return doc
 
 
 # ---------------------------------------------------------------------------
@@ -302,14 +315,23 @@ def clock_domain() -> str:
     return socket.gethostname()
 
 
-def dump_path_for(kind: str, ident: int, base: Optional[str] = None) -> Optional[str]:
+def dump_path_for(
+    kind: str,
+    ident: int,
+    base: Optional[str] = None,
+    group: Optional[int] = None,
+) -> Optional[str]:
     """Per-process-safe dump path: ``{base}.{r|c}{id}.json`` (multiple
-    replicas/clients — in one process or many — never clobber)."""
+    replicas/clients — in one process or many — never clobber).  Grouped
+    recorders append ``g{group}``: a GroupRuntime's G cores share one
+    replica id, so the group must be part of the filename or the cores'
+    dumps clobber each other."""
     base = base if base is not None else os.environ.get(TRACE_DUMP_ENV)
     if not base:
         return None
     tag = {"replica": "r", "client": "c"}.get(kind, kind)
-    return f"{base}.{tag}{ident}.json"
+    gtag = "" if group is None else f"g{group}"
+    return f"{base}.{tag}{ident}{gtag}.json"
 
 
 def dump_recorder(rec: FlightRecorder, base: Optional[str] = None,
@@ -317,7 +339,7 @@ def dump_recorder(rec: FlightRecorder, base: Optional[str] = None,
     """Write one recorder's dump; returns the path (None when the dump
     env/base is unset — the recorder may be enabled for live scraping
     only)."""
-    path = dump_path_for(rec.kind, rec.ident, base)
+    path = dump_path_for(rec.kind, rec.ident, base, group=rec.group)
     if path is None:
         return None
     doc = rec.to_dict()
@@ -342,6 +364,19 @@ def load_dumps(base: str) -> List[dict]:
     return docs
 
 
+def filter_group(docs: Iterable[dict], group: Optional[int]) -> List[dict]:
+    """Restrict a dump set to one consensus group: docs stamped with a
+    DIFFERENT group are dropped; unstamped docs (ungrouped recorders,
+    shared engine docs, clients without a group label) are kept — the
+    engine queues really are shared across groups, so excluding their
+    doc would just lose the queue-wait attribution.  ``group=None`` is
+    the identity."""
+    docs = list(docs)
+    if group is None:
+        return docs
+    return [d for d in docs if d.get("group") in (None, group)]
+
+
 def merged_stage_hists(docs: Iterable[dict]) -> Dict[str, Log2Histogram]:
     """Merge dumped stage histograms across recorders.  Client stages
     are namespaced (``client_sign``...) so the one table carries both
@@ -359,7 +394,9 @@ def merged_stage_hists(docs: Iterable[dict]) -> Dict[str, Log2Histogram]:
     return out
 
 
-def stage_table(docs: Iterable[dict], prefix: str) -> dict:
+def stage_table(
+    docs: Iterable[dict], prefix: str, group: Optional[int] = None
+) -> dict:
     """The bench's per-stage cost-breakdown keys:
 
     - ``{prefix}_stage_{name}_p50_ms`` — median time from the previous
@@ -369,10 +406,14 @@ def stage_table(docs: Iterable[dict], prefix: str) -> dict:
       replica pipeline by construction, so shares are computed over the
       replica stages only — they sum to 1.0).
 
+    ``group`` restricts the table to one consensus group's recorders
+    (see :func:`filter_group`) — the multi-group runtime dumps every
+    core into one dump set.
+
     Returns {} when no dump carries histogram data, so a tracing-disabled
     bench emits byte-identical keys to a tracing-absent one.
     """
-    hists = merged_stage_hists(docs)
+    hists = merged_stage_hists(filter_group(docs, group))
     if not hists:
         return {}
     out: dict = {}

@@ -39,6 +39,7 @@ class SimpleConfiger(api.Configer):
         timeout_viewchange: float = 8.0,
         peers: Optional[List[PeerAddr]] = None,
         batchsize_prepare: int = 64,
+        groups: int = 1,
     ):
         self._n = n
         self._f = f
@@ -51,6 +52,12 @@ class SimpleConfiger(api.Configer):
         # Max requests coalesced into one PREPARE (this build's request
         # batching; the reference has none — roadmap README.md:505).
         self.batchsize_prepare = batchsize_prepare
+        # Consensus groups per replica process (minbft_tpu/groups): G
+        # independent MinBFT instances over shared transport + one
+        # engine.  1 = the ungrouped runtime.  Like n/f this must be
+        # identical cluster-wide, so it lives in the shared file —
+        # CONSENSUS_GROUPS exists for test/bench layering only.
+        self.groups = groups
 
     @property
     def n(self) -> int:
@@ -120,6 +127,7 @@ def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfig
         batchsize_prepare=layered(
             "BATCHSIZE_PREPARE", proto.get("batchsizePrepare", 64), int
         ),
+        groups=layered("GROUPS", proto.get("groups", 1), int),
     )
 
 

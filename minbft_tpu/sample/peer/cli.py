@@ -43,8 +43,8 @@ def _env(name: str, fallback, choices=None):
 _PEER_OPTION_SCHEMA = {
     None: {"keys", "config", "log_level", "log_file", "auth", "transport"},
     "run": {"listen", "batch", "metrics_interval", "metrics_port",
-            "metrics_host"},
-    "request": {"client_id", "timeout"},
+            "metrics_host", "groups"},
+    "request": {"client_id", "timeout", "group"},
 }
 
 
@@ -210,6 +210,16 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         "endpoint is unauthenticated; widen deliberately)",
     )
     r.add_argument(
+        "--groups",
+        type=int,
+        default=_opt("groups", 0, section="run"),
+        help="host this many independent consensus groups in one replica "
+        "process over shared transport + one engine (minbft_tpu/groups; "
+        "README §Sharding).  0 (default) = the config's protocol.groups "
+        "value; 1 = the plain ungrouped runtime.  Must be identical "
+        "cluster-wide.",
+    )
+    r.add_argument(
         "--peer-idle-timeout",
         type=float,
         default=_opt("peer_idle_timeout", 0.0, section="run"),
@@ -248,6 +258,15 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
     )
     q.add_argument(
         "--timeout", type=float, default=_opt("timeout", 30.0, section="request")
+    )
+    q.add_argument(
+        "--group",
+        type=int,
+        default=_opt("group", -1, section="request"),
+        help="pin requests to this consensus group instead of routing by "
+        "the shard hash of the operation bytes (multi-group clusters; "
+        "-1 = route by key).  The group count comes from the config's "
+        "protocol.groups.",
     )
     q.add_argument(
         "--read-only",
@@ -320,6 +339,11 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         default=bool(_env("macs", 0)),
         help="include pairwise-MAC material (enables run/request --auth mac)",
     )
+    t.add_argument(
+        "--groups", type=int, default=1,
+        help="declare this many consensus groups in consensus.yaml "
+        "(protocol.groups; `peer run` hosts them all per replica)",
+    )
     return p
 
 
@@ -379,16 +403,22 @@ async def _run_replica(args) -> int:
             engine = BatchVerifier(max_batch=args.batch, buckets=(args.batch,))
             batch_signatures = True
 
-    if args.auth == "mac":
-        # device_macs follows the signature-placement rule: the HMAC batch
-        # kernel only beats host HMAC where the chip isn't remote-attached.
-        auth = store.mac_replica_authenticator(
-            args.id, engine=engine, device_macs=batch_signatures
-        )
-    else:
-        auth = store.replica_authenticator(
+    def make_auth():
+        # One call = one authenticator instance = one fresh USIG epoch
+        # (the keystore restores the sealed key per call), so construct
+        # exactly as many as the runtime needs: one ungrouped, or one
+        # per group below — never a spare.
+        if args.auth == "mac":
+            # device_macs follows the signature-placement rule: the HMAC
+            # batch kernel only beats host HMAC where the chip isn't
+            # remote-attached.
+            return store.mac_replica_authenticator(
+                args.id, engine=engine, device_macs=batch_signatures
+            )
+        return store.replica_authenticator(
             args.id, engine=engine, batch_signatures=batch_signatures
         )
+
     if args.transport == "tcp":
         # Half-open peer detection (read-idle teardown) is a property of
         # the native framing only; gRPC manages its own channel health.
@@ -400,10 +430,37 @@ async def _run_replica(args) -> int:
     for rid, addr in addrs.items():
         if rid != args.id:
             conn.connect_replica(rid, addr)
-    ledger = SimpleLedger()
-    replica = new_replica(
-        args.id, cfg, auth, conn, ledger, opts=_log_opts(args)
-    )
+    n_groups = args.groups if args.groups > 0 else getattr(cfg, "groups", 1)
+    grouped = n_groups > 1
+    if grouped:
+        # Multi-group runtime (README §Sharding): G independent group
+        # cores over this one listener + peer connection set, every
+        # core's verify/sign traffic coalescing in the ONE engine above.
+        # Each group needs its own authenticator INSTANCE (own USIG
+        # counter space — the keystore restores the same sealed key with
+        # a fresh epoch per call); GroupAuthenticator domain separation
+        # rides inside the runtime.
+        from ...core.options import resolve as resolve_options
+        from ...groups import new_group_runtime
+
+        # Same log options as the ungrouped path (level AND --log-file):
+        # resolve() materializes the minbft.replica{id} logger with its
+        # one owned handler, and every group core's child logger
+        # (minbft.replica{id}.g{g}) delivers into it by propagation.
+        ropts = resolve_options(args.id, _log_opts(args))
+        replica = new_group_runtime(
+            args.id,
+            cfg,
+            [make_auth() for _ in range(n_groups)],
+            conn,
+            [SimpleLedger() for _ in range(n_groups)],
+            logger=ropts.logger,
+        )
+    else:
+        ledger = SimpleLedger()
+        replica = new_replica(
+            args.id, cfg, make_auth(), conn, ledger, opts=_log_opts(args)
+        )
     server = ReplicaServer(replica)
     listen = args.listen or addrs[args.id]
     bound = await server.start(listen)
@@ -424,15 +481,27 @@ async def _run_replica(args) -> int:
     if args.metrics_port >= 0:
         from ...obs import prom as obs_prom
 
-        def render() -> str:
-            return obs_prom.render_families(
-                obs_prom.collect_replica(
-                    metrics=replica.metrics,
-                    recorder=replica.handlers.trace,
-                    engine=engine,
-                    replica_id=args.id,
+        if grouped:
+            # One family block per metric, samples labeled per group;
+            # the shared engine's families ride once (see
+            # obs.prom.collect_group_runtime).
+            def render() -> str:
+                return obs_prom.render_families(
+                    obs_prom.collect_group_runtime(
+                        replica, engine=engine, replica_id=args.id
+                    )
                 )
-            )
+
+        else:
+            def render() -> str:
+                return obs_prom.render_families(
+                    obs_prom.collect_replica(
+                        metrics=replica.metrics,
+                        recorder=replica.handlers.trace,
+                        engine=engine,
+                        replica_id=args.id,
+                    )
+                )
 
         metrics_server = obs_prom.MetricsServer(
             render, host=args.metrics_host, port=args.metrics_port
@@ -479,8 +548,22 @@ async def _run_replica(args) -> int:
 
         while not stop.is_set():
             await asyncio.sleep(args.metrics_interval)
-            snap = replica.metrics.snapshot()
-            snap["executed_per_sec"] = round(replica.metrics.executed_per_sec(), 2)
+            if grouped:
+                snap = replica.metrics_aggregate()
+                # Same schema as the ungrouped line: the one rate field
+                # is the cluster-process aggregate across group cores.
+                snap["executed_per_sec"] = round(
+                    sum(
+                        core.metrics.executed_per_sec()
+                        for core in replica.cores
+                    ),
+                    2,
+                )
+            else:
+                snap = replica.metrics.snapshot()
+                snap["executed_per_sec"] = round(
+                    replica.metrics.executed_per_sec(), 2
+                )
             print(f"metrics: {_json.dumps(snap)}", file=sys.stderr)
 
     metrics_task = (
@@ -538,17 +621,45 @@ async def _run_request(args) -> int:
         client_auth = store.mac_client_authenticator(args.client_id)
     else:
         client_auth = store.client_authenticator(args.client_id)
-    client = new_client(args.client_id, cfg.n, cfg.f, client_auth, conn)
+    n_groups = getattr(cfg, "groups", 1)
+    pin = getattr(args, "group", -1)
+    if n_groups > 1:
+        # Multi-group cluster: route each operation to its key-space
+        # shard (stable hash of the op bytes), or pin with --group.
+        from ...groups import MultiGroupClient
+
+        if pin >= n_groups:
+            # validate the pin up front: a clean CLI error, not a
+            # ValueError traceback out of the router mid-request
+            raise SystemExit(
+                f"peer: --group {pin} out of range (config declares "
+                f"{n_groups} groups: 0..{n_groups - 1})"
+            )
+        client = MultiGroupClient(
+            args.client_id, cfg.n, cfg.f, n_groups, client_auth, conn
+        )
+    elif pin > 0:
+        # --group 0 against an ungrouped config stays accepted: group 0
+        # IS the ungrouped wire format by definition (bare frames).
+        raise SystemExit(
+            f"peer: --group {pin} but the config declares no groups"
+        )
+    else:
+        client = new_client(args.client_id, cfg.n, cfg.f, client_auth, conn)
     await client.start()
     rc = 0
     try:
         for op in ops:
+            kw = {}
+            if n_groups > 1 and pin >= 0:
+                kw["group"] = pin
             result = await asyncio.wait_for(
                 client.request(
                     op,
                     read_only=getattr(args, "read_only", False),
                     read_fallback=not getattr(args, "no_read_fallback", False),
                     read_timeout=min(args.timeout, 30.0),
+                    **kw,
                 ),
                 args.timeout,
             )
@@ -904,6 +1015,7 @@ def _run_testnet_scaffold(args) -> int:
             "checkpointPeriod": 128,
             "logsize": 0,
             "batchsizePrepare": 64,
+            "groups": max(1, args.groups),
             "timeout": {"request": "8s", "prepare": "4s", "viewchange": "8s"},
         },
         "peers": peers,

@@ -83,9 +83,14 @@ class ReplicaMetrics:
     - ``timeouts_request`` / ``timeouts_prepare``
     """
 
-    def __init__(self):
+    def __init__(self, group=None):
         from ..obs.hist import Log2CountHistogram, Log2Histogram
 
+        # Consensus-group id (multi-group runtime, minbft_tpu/groups):
+        # None for an ungrouped replica.  Pure labeling — the Prometheus
+        # exposition adds a ``group`` label and aggregate() callers can
+        # keep per-group snapshots separable.
+        self.group = group
         self.counters: Dict[str, int] = {}
         self.execute_latency = LatencyReservoir()
         # Streaming log2 histogram next to the reservoir (obs/hist.py):

@@ -165,3 +165,18 @@ def test_committed_artifacts_pass_the_default_gate():
     baseline must gate green with the default thresholds (this is what
     `make check` runs)."""
     assert benchgate_cli.main([]) == 0
+
+
+def test_groups_sweep_headline_is_gated():
+    """The multi-group sweep's aggregate headline (ISSUE 10:
+    groups{G}_req_per_sec_mean triples from bench_groups) participates
+    in the gate exactly like every other config — a 60% drop at one
+    sweep point must regress even when the classic configs hold."""
+    base = _artifact(100.0)
+    for G in (1, 4):
+        base.update(_artifact(40.0 * G, prefix=f"groups{G}"))
+    cand = dict(base)
+    cand["groups4_req_per_sec_mean"] = 40.0 * 4 * 0.4
+    report = benchgate.compare(base, cand)
+    assert [r.key for r in report.results] == ["e2e", "groups1", "groups4"]
+    assert [r.status for r in report.results] == ["ok", "ok", "regression"]

@@ -336,3 +336,49 @@ def test_engine_queue_doc_round_trip():
         assert ratio is not None and 0.0 <= ratio <= 1.0
 
     asyncio.run(run())
+
+
+def test_grouped_dumps_pair_within_groups_only():
+    """Multi-group dump sets (ISSUE 10): the G inner clients share one
+    client id and their seq spaces can overlap, so (cid, seq) pairing
+    must happen WITHIN a group.  Two groups with IDENTICAL (cid, seq)
+    event keys but different timelines: the stitcher must yield each
+    group's requests separately (2x the paths, correct totals), never a
+    cross-group chimera — and the group= filter must reproduce each
+    group's table alone."""
+    docs_a, truth_a = synth_docs(domains=["h"] * 4, client_domain="h")
+    # group 1: same cid/seq keys, every event shifted by a constant so a
+    # cross-group stitch would produce wildly different (even negative)
+    # spans; a pure shift leaves within-group spans identical.
+    shift = 3_600 * 10**9
+    docs_b, _ = synth_docs(
+        domains=["h"] * 4, client_domain="h",
+        offsets=[shift] * 4, client_offset=shift,
+    )
+    for d in docs_a:
+        if d["kind"] != "engine":
+            d["group"] = 0
+    for d in docs_b:
+        if d["kind"] != "engine":
+            d["group"] = 1
+    merged = docs_a + [d for d in docs_b if d["kind"] != "engine"]
+    res = critpath.cluster_paths(merged)
+    assert len(res.paths) == 2 * len(truth_a)
+    assert res.skipped == 0
+    table_all = critpath.critpath_table(merged, "t")
+    assert table_all["t_critpath_requests"] == 2 * len(truth_a)
+    # per-group filter: exactly one group's requests, ground-truth total
+    exp_total = sum(expected_segments().values())
+    for g in (0, 1):
+        tg = critpath.critpath_table(merged, "t", group=g)
+        assert tg["t_critpath_requests"] == len(truth_a)
+        assert tg["t_critpath_total_p50_ms"] == pytest.approx(
+            exp_total / 1e6, rel=0.01
+        )
+        assert "t_critpath_negative_spans" not in tg
+    # the unpartitioned merge must agree with the per-group totals (no
+    # cross-group spans contaminated the timeline)
+    assert table_all["t_critpath_total_p50_ms"] == pytest.approx(
+        exp_total / 1e6, rel=0.01
+    )
+    assert "t_critpath_negative_spans" not in table_all

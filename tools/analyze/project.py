@@ -352,6 +352,48 @@ def default_config() -> AnalyzeConfig:
                 locks=(),
                 guarded=("counters", "links", "frames"),
             ),
+            # Multi-group shared transport (minbft_tpu/groups, ISSUE 10):
+            # ONE _SharedChannel per destination is shared by G logical
+            # group streams on one event loop — the per-group rx queue
+            # registry, shared tx queue, and driver-task handle must
+            # only mutate loop-atomically (the group-isolation contract:
+            # a suspend-crossing mutation here could tear one group's
+            # attach against another's EOF sweep).
+            LockClassSpec(
+                path="minbft_tpu/groups/runtime.py",
+                cls="_SharedChannel",
+                locks=(),
+                guarded=("_tx", "_rx", "_driver", "_closed"),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/groups/runtime.py",
+                cls="SharedChannelMux",
+                locks=(),
+                guarded=("_channels",),
+            ),
+            # The runtime's core list and the router's group map are
+            # written once at construction and read by every stream
+            # handler task afterwards — any later mutation racing an
+            # await is a bug (groups cannot be added live; that is the
+            # reconfiguration item on the roadmap, not an accident).
+            LockClassSpec(
+                path="minbft_tpu/groups/runtime.py",
+                cls="GroupRuntime",
+                locks=(),
+                guarded=("cores", "n_groups"),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/groups/router.py",
+                cls="ShardRouter",
+                locks=(),
+                guarded=("n_groups",),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/groups/router.py",
+                cls="MultiGroupClient",
+                locks=(),
+                guarded=("_clients", "router"),
+            ),
             # The software USIG's counter is certified-then-incremented
             # under a real threading.Lock (reference ecallLock).
             LockClassSpec(
