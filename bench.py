@@ -77,6 +77,26 @@ bench_extras line carries the headline-grade subset):
       groups{G}_req_per_sec_mean/_stddev/_runs gate triple (benchgate
       gates the sweep headline like every other config), and
       groups_sweep_Gs / groups_sweep_per_group_requests.
+  {prefix}_util_busy / _util_fill / _util_useful /
+  {prefix}_util_effective_per_sec / _util_per_device_per_sec /
+  {prefix}_util_ceiling_per_sec / _util_ceiling_source /
+  {prefix}_util_idle_s / _util_lanes_{useful,padding,memo,fallback}
+      device-utilization ledger (minbft_tpu/obs/ledger.py, ISSUE 14):
+      the multiplicative headroom identity for the config's USIG device
+      queue — ceiling × busy × fill × useful ≡ effective lanes/sec, the
+      ceiling calibrated per backend (cpu-probe: one timed full-bucket
+      dispatch on the warm queue; last_tpu:FILE on the chip) and its
+      provenance always stamped.  The four lane classes sum to the
+      window's total lane demand.  perf/UTILIZATION.md reads the table;
+      benchgate gates *_util_effective_per_sec.
+  {prefix}_queue_depth_peak   high-water mark of the USIG queue's
+      pending depth over the timed run (engine peak counters — backlog
+      the point-in-time depth gauge misses)
+  {prefix}_timeline   per-second saturation timeline from the telemetry
+      rings (minbft_tpu/obs/timeseries.py): {interval_s, series:
+      {committed, verify_items, verify_fill, queue_depth:
+      {start_index, values}}} — the SHAPE of the run the scalar means
+      flatten (BENCH_extras.json only; the printed line stays compact)
   uvloop   True when MINBFT_UVLOOP (auto-detect) put uvloop behind the
       bench's event loops — numbers are never silently attributed to
       the wrong loop
@@ -1069,11 +1089,28 @@ async def _bench_cluster(
         "hmac": ("hmac_sha256", shared._dispatch_hmac, (b"\x00" * 32,) * 3),
         "ecdsa": ("ecdsa_p256", shared._dispatch_ecdsa, ((0, 0), b"\x00" * 32, (0, 0))),
     }.get(usig_kind)
+    util_ceiling = None  # (lanes_per_sec, provenance) for the ledger
     if warm_queue is not None:
         qname, dispatch, pad_item = warm_queue
         shared._queue(qname, dispatch)  # ensure stats slot exists
         for b in shared.buckets:
             await asyncio.to_thread(dispatch, [pad_item] * b)
+        # Ceiling calibration for the utilization ledger (ISSUE 14): on
+        # the chip the committed last_tpu kernel rate; otherwise one
+        # timed full-bucket dispatch on the NOW-WARM queue (probing a
+        # cold queue would time the compiler, not the lane rate).
+        from minbft_tpu.obs import DeviceLedger as _DL
+
+        if jax.default_backend() != "cpu":
+            util_ceiling = _tpu_ceiling(usig_kind)
+        if util_ceiling is None:
+            rate = await asyncio.to_thread(
+                _DL.probe_ceiling, dispatch, pad_item, max_batch
+            )
+            util_ceiling = (
+                rate,
+                "cpu-probe" if jax.default_backend() == "cpu" else "probe",
+            )
     if scheme == "ed25519":
         shared._queue("ed25519", shared._dispatch_ed25519)
         for b in shared.buckets:
@@ -1088,6 +1125,34 @@ async def _bench_cluster(
     for e in {id(e): e for e in engines}.values():
         for q in e._sign_queues.values():
             q.stats = SignStats()
+
+    # Device-utilization ledger + telemetry rings (ISSUE 14): the ledger
+    # baselines AFTER the stats reset so its window is exactly the timed
+    # protocol traffic; the sampler ticks through the drive and becomes
+    # the {prefix}_timeline saturation shape.  Both read the SHARED
+    # engine — the isolated-engines topology has no single device-time
+    # clock to decompose, so its util keys are honestly absent.
+    from minbft_tpu.obs import CounterSampler, DeviceLedger, TimeSeries
+    from minbft_tpu.obs.timeseries import register_engine_series
+
+    usig_queue = "hmac_sha256" if usig_kind == "hmac" else "ecdsa_p256"
+    ledger = DeviceLedger(shared)
+    if util_ceiling is not None:
+        ledger.set_ceiling(usig_queue, util_ceiling[0], util_ceiling[1])
+    tseries = TimeSeries()
+    sampler = CounterSampler(tseries)
+    register_engine_series(sampler, shared)
+    sampler.add_rate(
+        "committed",
+        # cluster-committed watermark: every replica executes every
+        # request, so MIN is the count committed everywhere (a sum
+        # would read n× the client-visible rate)
+        lambda: min(
+            (r.metrics.counters.get("requests_executed", 0)
+             for r in replicas),
+            default=0,
+        ),
+    )
 
     per_client = n_requests // n_clients
     n_requests = per_client * n_clients
@@ -1124,9 +1189,16 @@ async def _bench_cluster(
                 ]
             )
 
+    sampler_task = asyncio.get_running_loop().create_task(sampler.run())
     t0 = time.time()
     await asyncio.gather(*[drive(c) for c in clients])
     dt = time.time() - t0
+    util_keys = ledger.util_keys(prefix, usig_queue)
+    sampler_task.cancel()
+    try:
+        await sampler_task
+    except asyncio.CancelledError:
+        pass
 
     batch_stats = {}
     for e in {id(e): e for e in engines}.values():
@@ -1146,7 +1218,6 @@ async def _bench_cluster(
             agg["memo_hits"] += st.memo_hits
             agg["host_prep_time_s"] += st.host_prep_time_s
             agg["device_time_s"] += st.device_time_s
-    usig_queue = "hmac_sha256" if usig_kind == "hmac" else "ecdsa_p256"
     sig_stats = batch_stats.get("ed25519") if scheme == "ed25519" else None
 
     # Sign-queue stats (REQUEST/REPLY signatures routed through the
@@ -1320,6 +1391,37 @@ async def _bench_cluster(
         # so a trace-disabled run's key set is byte-identical to a
         # trace-absent one): {prefix}_stage_{name}_p50_ms / _share.
         **stage_keys,
+        # Utilization decomposition (ISSUE 14): the multiplicative
+        # headroom identity for the USIG device queue over the timed
+        # window — {prefix}_util_busy × _fill × _useful against the
+        # calibrated _ceiling_per_sec equals _effective_per_sec
+        # (obs/ledger.py; perf/UTILIZATION.md reads it).  Absent for the
+        # isolated-engines topology (no single shared device clock).
+        **util_keys,
+        # High-water queue backlog over the run (the point the depth
+        # gauge always misses) and the per-second saturation timeline.
+        f"{prefix}_queue_depth_peak": shared.queue_depth_peaks().get(
+            usig_queue, 0
+        ),
+        **(
+            {
+                f"{prefix}_timeline": {
+                    "interval_s": tseries.interval_s,
+                    "series": {
+                        name: {"start_index": start,
+                               "values": [round(v, 2) for v in vals]}
+                        for name, (start, vals) in (
+                            (nm, tseries.timeline(nm))
+                            for nm in ("committed", "verify_items",
+                                       "verify_fill", "queue_depth")
+                        )
+                        if vals
+                    },
+                }
+            }
+            if tseries.names()
+            else {}
+        ),
     }
 
 
@@ -1541,6 +1643,23 @@ async def _bench_groups_cluster(
         await asyncio.to_thread(
             shared._dispatch_hmac, [(b"\x00" * 32,) * 3] * max_batch
         )
+        # Ceiling calibration (same rule as _bench_cluster): last_tpu on
+        # the chip, a timed full-bucket dispatch on the warm CPU queue.
+        from minbft_tpu.obs import CounterSampler, DeviceLedger, TimeSeries
+        from minbft_tpu.obs.timeseries import register_engine_series
+
+        util_ceiling = None
+        if jax.default_backend() != "cpu":
+            util_ceiling = _tpu_ceiling("hmac")
+        if util_ceiling is None:
+            rate = await asyncio.to_thread(
+                DeviceLedger.probe_ceiling, shared._dispatch_hmac,
+                (b"\x00" * 32,) * 3, max_batch,
+            )
+            util_ceiling = (
+                rate,
+                "cpu-probe" if jax.default_backend() == "cpu" else "probe",
+            )
         await asyncio.gather(*[
             asyncio.wait_for(clients[0].request(b"warmup", group=g), 600)
             for g in range(n_groups)
@@ -1549,6 +1668,27 @@ async def _bench_groups_cluster(
             q.stats = VerifyStats()
         for q in shared._sign_queues.values():
             q.stats = SignStats()
+        ledger = DeviceLedger(shared)
+        ledger.set_ceiling("hmac_sha256", util_ceiling[0], util_ceiling[1])
+        tseries = TimeSeries()
+        sampler = CounterSampler(tseries)
+        register_engine_series(sampler, shared)
+        sampler.add_rate(
+            "committed",
+            # min over replica processes of the per-process cross-group
+            # total: the aggregate committed everywhere (a flat sum
+            # would read n× the client-visible rate)
+            lambda: min(
+                (
+                    sum(
+                        core.metrics.counters.get("requests_executed", 0)
+                        for core in rt.cores
+                    )
+                    for rt in runtimes
+                ),
+                default=0,
+            ),
+        )
 
         per_client = max(per_group_requests * n_groups // n_clients, 1)
         total = per_client * n_clients
@@ -1572,9 +1712,16 @@ async def _bench_groups_cluster(
                     *[timed(mc, k) for k in range(k0, min(k0 + depth, per_client))]
                 )
 
+        sampler_task = asyncio.get_running_loop().create_task(sampler.run())
         t0 = time.time()
         await asyncio.gather(*[drive(mc) for mc in clients])
         dt = time.time() - t0
+        util_keys = ledger.util_keys(f"groups{n_groups}", "hmac_sha256")
+        sampler_task.cancel()
+        try:
+            await sampler_task
+        except asyncio.CancelledError:
+            pass
 
         usig = shared.stats.get("hmac_sha256")
         prefix = f"groups{n_groups}"
@@ -1597,6 +1744,33 @@ async def _bench_groups_cluster(
             f"{prefix}_verify_batches": usig.batches if usig else 0,
             f"{prefix}_device_verifies_per_sec": round(
                 (usig.items if usig else 0) / dt, 1
+            ),
+            # Utilization decomposition + saturation timeline for the
+            # sweep point (same schema as the e2e configs) — the sweep's
+            # claim is that fill RISES with G, and util_fill is now the
+            # calibrated version of that claim.
+            **util_keys,
+            f"{prefix}_queue_depth_peak": shared.queue_depth_peaks().get(
+                "hmac_sha256", 0
+            ),
+            **(
+                {
+                    f"{prefix}_timeline": {
+                        "interval_s": tseries.interval_s,
+                        "series": {
+                            name: {"start_index": start,
+                                   "values": [round(v, 2) for v in vals]}
+                            for name, (start, vals) in (
+                                (nm, tseries.timeline(nm))
+                                for nm in ("committed", "verify_items",
+                                           "verify_fill", "queue_depth")
+                            )
+                            if vals
+                        },
+                    }
+                }
+                if tseries.names()
+                else {}
             ),
         }
     finally:
@@ -1725,6 +1899,27 @@ def _last_tpu_numbers() -> "dict | None":
                 )
         return block
     return None
+
+
+def _tpu_ceiling(usig_kind: str) -> "tuple[float, str] | None":
+    """Calibrated lane ceiling for the utilization ledger when running
+    ON the chip: the newest committed real-TPU round's kernel rate (the
+    standing rule — only real-TPU numbers live in last_tpu blocks, so
+    the provenance stamp names the source file).  Returns (lanes/sec,
+    source) or None when no TPU round is on disk."""
+    last = _last_tpu_numbers()
+    if not last:
+        return None
+    key = {
+        "hmac": "hmac_verifies_per_sec",
+        "ecdsa": "ecdsa_verifies_per_sec",
+    }.get(usig_kind)
+    v = (last.get("extras") or {}).get(key) if key else None
+    if v is None and usig_kind == "ecdsa":
+        v = (last.get("headline") or {}).get("value")
+    if not v:
+        return None
+    return float(v), f"last_tpu:{last.get('source', '?')}"
 
 
 def main() -> None:
@@ -2051,6 +2246,8 @@ def main() -> None:
         "last_tpu",
         "compile_cache_entries",
         "groups_sweep",
+        "_util_",
+        "queue_depth_peak",
     )
     compact = {
         k: extras[k] for k in sorted(extras) if any(p in k for p in keep)

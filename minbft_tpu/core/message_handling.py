@@ -1536,6 +1536,9 @@ class Handlers:
             self.view_change_state.prune_through(nv.new_view)
             self.commitment_collector.prune_view_bases(nv.new_view)
             self.metrics.inc("view_changes_completed")
+            # Health surface (ISSUE 14): the scrape-side minbft_health_view
+            # gauge reads this stamp instead of suspending on view_state.
+            self.metrics.note_view(nv.new_view)
             reproposal_ids = [
                 [seq for _, seq in viewchange_mod.batch_key(p)]
                 for p in s_prepares

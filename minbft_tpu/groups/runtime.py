@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import AsyncIterator, Dict, List, Optional
+from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 
 from .. import api
 from ..core.message_handling import (
@@ -779,6 +779,10 @@ class GroupRuntime(api.Replica):
                 group=g,
             )
             self.cores.append(core)
+        # Stale-group detector state (ISSUE 14): per-group
+        # (requests_executed count, monotonic stamp of last change),
+        # lazily refreshed by stale_groups() — no watcher task.
+        self._progress: Dict[int, Tuple[int, float]] = {}
 
     # -- api.Replica ---------------------------------------------------
 
@@ -825,6 +829,39 @@ class GroupRuntime(api.Replica):
         from ..utils.metrics import aggregate
 
         return aggregate(core.metrics.snapshot() for core in self.cores)
+
+    def stale_groups(self, threshold_s: float = 30.0) -> Set[int]:
+        """Groups whose commit counter has not moved for ``threshold_s``
+        while at least one sibling group progressed within that window.
+
+        The sibling clause keeps an idle cluster healthy: staleness is
+        *relative* starvation (one group wedged while others commit),
+        not absence of load.  State is refreshed lazily on each call —
+        callers (the Prometheus scrape, ``peer top``) poll anyway, so a
+        watcher task would add nothing but a thread.
+        """
+        import time as _time
+
+        now = _time.monotonic()
+        freshest = None
+        for core in self.cores:
+            count = core.metrics.counters.get("requests_executed", 0)
+            prev = self._progress.get(core.group)
+            if prev is None or prev[0] != count:
+                self._progress[core.group] = (count, now)
+                changed = now
+            else:
+                changed = prev[1]
+            if freshest is None or changed > freshest:
+                freshest = changed
+        if freshest is None or now - freshest > threshold_s:
+            # Everyone is quiet (or there are no cores): idle, not stale.
+            return set()
+        return {
+            g
+            for g, (_, changed) in self._progress.items()
+            if now - changed > threshold_s
+        }
 
     def dump_trace(self, base=None) -> List[str]:
         """Dump every group core's flight recorder (one file per core —

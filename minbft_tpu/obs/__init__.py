@@ -21,7 +21,19 @@ instrumentation is leveled logging):
   causal timeline per committed request, with queue-wait and loop-lag
   attribution (perf/CRITICAL_PATH.md);
 - :mod:`~minbft_tpu.obs.looplag` — event-loop scheduling-lag sampler
-  (GIL/loop saturation as a first-class metric).
+  (GIL/loop saturation as a first-class metric);
+- :mod:`~minbft_tpu.obs.timeseries` — fixed-capacity per-interval
+  counter-delta rings (the saturation timeline: shape-over-time, not
+  just end-of-run means), mergeable like the histograms and dumped as
+  ``{base}.ts.json`` next to the flight-recorder dumps;
+- :mod:`~minbft_tpu.obs.ledger` — the device-utilization ledger: busy
+  vs idle wall-seconds per engine queue, lanes classed useful /
+  padding / memo-duplicate / host-fallback, and the multiplicative
+  headroom decomposition against a calibrated per-backend ceiling
+  (perf/UTILIZATION.md);
+- :mod:`~minbft_tpu.obs.runinfo` — per-incarnation ``RUN_ID`` and the
+  ``minbft_build_info`` attribution block every dump and exposition
+  carries.
 
 Nothing in this package is reachable from jitted code (enforced by the
 ``tools/analyze`` trace-purity pass), and with tracing disabled the
@@ -29,12 +41,20 @@ protocol pays one predicated attribute check per hook.
 """
 
 from .hist import Log2Histogram
+from .ledger import Decomposition, DeviceLedger, QueueWindow
 from .prom import (
     MetricsServer,
     collect_faultnet,
     collect_replica,
     render_families,
     scrape,
+)
+from .timeseries import (
+    CounterSampler,
+    IncarnationMismatch,
+    TimeSeries,
+    dump_timeseries,
+    merge_timeseries_docs,
 )
 from .trace import (
     CLIENT_STAGES,
@@ -51,15 +71,23 @@ from .trace import (
 __all__ = [
     "CLIENT_STAGES",
     "REPLICA_STAGES",
+    "CounterSampler",
+    "Decomposition",
+    "DeviceLedger",
     "FlightRecorder",
+    "IncarnationMismatch",
     "Log2Histogram",
     "MTStageRing",
     "MetricsServer",
+    "QueueWindow",
     "StageRing",
+    "TimeSeries",
     "collect_faultnet",
     "collect_replica",
     "dump_recorder",
+    "dump_timeseries",
     "load_dumps",
+    "merge_timeseries_docs",
     "render_families",
     "scrape",
     "stage_table",

@@ -114,6 +114,32 @@ class ClusterPaths:
     quorum: int  # f+1 used for the rank-based tail
     clock_err_ns: float  # max pairwise alignment uncertainty
     negative_spans: int  # clock-sanity: negatives across merged hists
+    # Incarnation honesty (ISSUE 14): dump docs dropped because the same
+    # (kind, id, group) appeared under two run_ids — a restarted process
+    # reuses its replica id AND its (client_id, seq) keyspace, so
+    # splicing both incarnations would manufacture chimera paths.
+    refused_docs: int = 0
+
+
+def _drop_conflicting_incarnations(docs: List[dict]) -> Tuple[List[dict], int]:
+    """Drop every doc of any identity that appears under two different
+    ``run_id``s (docs without the stamp — pre-ISSUE-14 dumps — are
+    trusted as single-incarnation; mixing a stamped and an unstamped doc
+    of one identity is indistinguishable from a restart, so it refuses
+    too once any stamped doc exists for that identity)."""
+    runs: Dict[Tuple, set] = {}
+    for d in docs:
+        if d.get("kind") in ("replica", "client") and d.get("id") is not None:
+            key = (d.get("kind"), d.get("id"), d.get("group"))
+            runs.setdefault(key, set()).add(d.get("run_id"))
+    conflicted = {k for k, v in runs.items() if len(v) > 1}
+    if not conflicted:
+        return docs, 0
+    kept = [
+        d for d in docs
+        if (d.get("kind"), d.get("id"), d.get("group")) not in conflicted
+    ]
+    return kept, len(docs) - len(kept)
 
 
 def engine_queue_doc(engine, ident: int = 0) -> dict:
@@ -192,6 +218,10 @@ def cluster_paths(docs: Iterable[dict], quorum: Optional[int] = None) -> Cluster
     bound for the dumped replica count.
     """
     docs = list(docs)
+    # Incarnation refusal BEFORE any stitching: two run_ids under one
+    # replica/client identity are two processes whose (client_id, seq)
+    # keys overlap — their events must never meet in one path.
+    docs, refused = _drop_conflicting_incarnations(docs)
     groups = {d["group"] for d in docs if d.get("group") is not None}
     if len(groups) > 1:
         # Multi-group dump set (a GroupRuntime process dumps every core,
@@ -218,6 +248,7 @@ def cluster_paths(docs: Iterable[dict], quorum: Optional[int] = None) -> Cluster
         # Unstamped docs rode every partition: recount their
         # negative-span tallies exactly once over the full set.
         merged.negative_spans = sum(_doc_negatives(d) for d in docs)
+        merged.refused_docs = refused
         return merged
     replica_docs = [d for d in docs if d.get("kind") == "replica"]
     client_docs = [d for d in docs if d.get("kind") == "client"]
@@ -233,7 +264,7 @@ def cluster_paths(docs: Iterable[dict], quorum: Optional[int] = None) -> Cluster
             quorum = (max(len(replica_docs) - 1, 0)) // 2 + 1
     result = ClusterPaths(
         paths=[], skipped=0, quorum=quorum, clock_err_ns=0.0,
-        negative_spans=negative_spans,
+        negative_spans=negative_spans, refused_docs=refused,
     )
     if not replica_docs or not client_docs:
         return result
@@ -429,4 +460,8 @@ def critpath_table(
     out[f"{prefix}_critpath_clock_err_ms"] = round(res.clock_err_ns / 1e6, 3)
     if res.negative_spans:
         out[f"{prefix}_critpath_negative_spans"] = res.negative_spans
+    if res.refused_docs:
+        # Incarnation sanity (only-when-nonzero, like negative_spans): a
+        # nonzero count means the dump set mixed restarts of one id.
+        out[f"{prefix}_critpath_refused_docs"] = res.refused_docs
     return out

@@ -117,6 +117,9 @@ def collect_replica(
     engine=None,
     replica_id: Optional[int] = None,
     group: Optional[int] = None,
+    timeseries=None,
+    groups: Optional[int] = None,
+    stall_after_s: float = 30.0,
 ) -> List[Family]:
     """Build the metric families for one replica process.
 
@@ -141,6 +144,24 @@ def collect_replica(
         base["group"] = str(group)
     fams: List[Family] = []
     if metrics is not None:
+        # Incarnation attribution (ISSUE 14): which PROCESS produced
+        # every series in this exposition.  Value is the constant 1 —
+        # the information is the labels (the kube_state_metrics idiom),
+        # so merged multi-target scrapes stay attributable per pid/rev.
+        from . import runinfo
+
+        info = runinfo.build_info(
+            replica_id=replica_id, group=group, groups=groups
+        )
+        fams.append(
+            (
+                "minbft_build_info",
+                "gauge",
+                "process incarnation attribution (pid, run_id, backend, "
+                "git rev); value is always 1",
+                [({**base, **info}, 1)],
+            )
+        )
         # dict(...) snapshots the counter map once: the loop may insert
         # new counters mid-walk.
         for cname, v in sorted(dict(metrics.counters).items()):
@@ -192,6 +213,45 @@ def collect_replica(
                     [(base, lag_hist)],
                 )
             )
+        # Health monitors (ISSUE 14): evaluated AT SCRAPE TIME from the
+        # metrics' stamps — no detector thread to die silently.
+        if hasattr(metrics, "current_view"):
+            fams.append(
+                (
+                    "minbft_health_view",
+                    "gauge",
+                    "view this replica currently operates in",
+                    [(base, int(metrics.current_view))],
+                )
+            )
+        if hasattr(metrics, "stalled"):
+            fams.append(
+                (
+                    "minbft_health_commit_stall",
+                    "gauge",
+                    "1 when messages keep arriving but nothing has "
+                    f"executed for >{stall_after_s:g}s (commit stall); "
+                    "an idle replica reads 0",
+                    [(base, 1 if metrics.stalled(stall_after_s) else 0)],
+                )
+            )
+    if timeseries is not None:
+        # Recent-window readings from the telemetry rings
+        # (obs/timeseries.py): rate series as per-second rates over the
+        # last 10 completed intervals, gauge series as window means —
+        # the live numbers `peer top --once` renders without needing two
+        # scrapes to diff.
+        win = timeseries.window(10 * timeseries.interval_s)
+        for sname in sorted(win):
+            fams.append(
+                (
+                    f"minbft_window_{sname}",
+                    "gauge",
+                    f"recent-window reading of the {sname} telemetry "
+                    "ring (last 10 intervals)",
+                    [(base, round(win[sname], 3))],
+                )
+            )
     if recorder is not None:
         samples = []
         for name, h in recorder.stage_hists().items():
@@ -234,23 +294,51 @@ def merge_family_lists(lists: Iterable[List[Family]]) -> List[Family]:
     ]
 
 
-def collect_group_runtime(runtime, engine=None, replica_id=None) -> List[Family]:
+def collect_group_runtime(runtime, engine=None, replica_id=None,
+                          timeseries=None) -> List[Family]:
     """Families for a :class:`minbft_tpu.groups.GroupRuntime`: one
     ``collect_replica`` per group core (every series carries its
     ``group`` label), the shared engine's families once (its queues
-    really are shared — splitting them per group would double-count)."""
+    really are shared — splitting them per group would double-count).
+    The time-series rings and the stale-group health gauge are
+    process-level and likewise emitted once."""
+    n_groups = len(runtime.cores)
     lists = [
         collect_replica(
             metrics=core.metrics,
             recorder=core.handlers.trace,
             replica_id=replica_id,
             group=core.group,
+            groups=n_groups,
         )
         for core in runtime.cores
     ]
     if engine is not None:
         lists.append(collect_replica(engine=engine, replica_id=replica_id))
-    return merge_family_lists(lists)
+    if timeseries is not None:
+        lists.append(
+            collect_replica(timeseries=timeseries, replica_id=replica_id)
+        )
+    fams = merge_family_lists(lists)
+    stale_fn = getattr(runtime, "stale_groups", None)
+    if stale_fn is not None:
+        base = {} if replica_id is None else {"replica": str(replica_id)}
+        stale = stale_fn()
+        fams.append(
+            (
+                "minbft_health_stale_group",
+                "gauge",
+                "1 when this group core has made no progress while a "
+                "sibling group on the same process has (stale-group "
+                "detector, groups/runtime.py)",
+                [
+                    ({**base, "group": str(core.group)},
+                     1 if core.group in stale else 0)
+                    for core in runtime.cores
+                ],
+            )
+        )
+    return fams
 
 
 def collect_faultnet(census, base: Optional[Dict[str, str]] = None) -> List[Family]:
@@ -305,9 +393,13 @@ def collect_faultnet(census, base: Optional[Dict[str, str]] = None) -> List[Fami
 
 def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
     fams: List[Family] = []
-    for side, stats_map, depths in (
-        ("verify", engine.stats, engine.queue_depths()),
-        ("sign", engine.sign_stats, engine.sign_queue_depths()),
+    peak_fn = getattr(engine, "queue_depth_peaks", None)
+    sign_peak_fn = getattr(engine, "sign_queue_depth_peaks", None)
+    for side, stats_map, depths, peaks in (
+        ("verify", engine.stats, engine.queue_depths(),
+         peak_fn() if peak_fn else {}),
+        ("sign", engine.sign_stats, engine.sign_queue_depths(),
+         sign_peak_fn() if sign_peak_fn else {}),
     ):
         counters: Dict[str, List] = {
             "items": [],
@@ -349,10 +441,12 @@ def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
                 # upper bound of the log2 occupancy bucket, in items
                 lbo["le_items"] = str(1 << int(log2_size))
                 occupancy.append((lbo, cnt))
+        peak_samples: List = []
         for qname, depth in sorted(depths.items()):
             lb = dict(base)
             lb["queue"] = qname
             depth_samples.append((lb, depth))
+            peak_samples.append((lb, peaks.get(qname, depth)))
         p = f"minbft_{side}_queue"
         fams.append((f"{p}_items_total", "counter",
                      f"{side} items dispatched", counters["items"]))
@@ -384,6 +478,10 @@ def _collect_engine(engine, base: Dict[str, str]) -> List[Family]:
                      service_samples))
         fams.append((f"{p}_depth", "gauge",
                      "items pending in the queue right now", depth_samples))
+        fams.append((f"{p}_depth_peak", "gauge",
+                     "high-water mark of the queue depth since the last "
+                     "scrape (peak backlog the point-in-time gauge misses)",
+                     peak_samples))
     return fams
 
 

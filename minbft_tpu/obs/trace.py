@@ -343,6 +343,15 @@ def dump_recorder(rec: FlightRecorder, base: Optional[str] = None,
     if path is None:
         return None
     doc = rec.to_dict()
+    # Incarnation attribution (ISSUE 14): every dump says which process
+    # produced it, so cross-node mergers can refuse to splice a restarted
+    # replica onto its predecessor's timeline (obs/critpath.py) and a
+    # merged artifact's numbers stay traceable to concrete pids/revs.
+    # ``extra`` may override (tests construct synthetic incarnations).
+    from . import runinfo
+
+    doc.setdefault("run_id", runinfo.RUN_ID)
+    doc.setdefault("build", runinfo.build_info())
     if extra:
         doc.update(extra)
     with open(path, "w") as fh:

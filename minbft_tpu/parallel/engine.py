@@ -209,6 +209,14 @@ class _DispatchQueue:
         self.pending: List[Tuple[object, asyncio.Future, int]] = []
         self._flush_handle: Optional[asyncio.Handle] = None
         self.inflight = 0
+        # High-water mark of len(pending) since the last peak snapshot
+        # (ISSUE 14): the point-in-time depth gauge samples whatever
+        # backlog happens to exist AT scrape time and misses every burst
+        # between scrapes — the peak is what capacity planning needs.
+        # Updated loop-side in _schedule_flush (every growth path runs
+        # through it); read-and-reset from the scrape thread is a pair
+        # of GIL-atomic int ops (see queue_depth_peaks).
+        self.peak_depth = 0
         self._consecutive_timeouts = 0
         self._device_written_off = False
         self._device_ever_succeeded = False
@@ -295,6 +303,11 @@ class _DispatchQueue:
 
     def _schedule_flush(self, fut: asyncio.Future) -> asyncio.Future:
         loop = asyncio.get_running_loop()
+        # Peak BEFORE any flush decision: this line sees the deepest the
+        # backlog ever gets (every submit/submit_many lands here with its
+        # items already appended, before _flush_now pops them).
+        if len(self.pending) > self.peak_depth:
+            self.peak_depth = len(self.pending)  # noqa: LD001
         if len(self.pending) >= self.engine.max_batch:
             self._flush_now("full")
         elif self.inflight == 0 and self._flush_handle is None:
@@ -753,6 +766,31 @@ class BatchVerifier:
         return {
             name: len(q.pending) for name, q in dict(self._sign_queues).items()
         }
+
+    def queue_depth_peaks(self, reset: bool = True) -> Dict[str, int]:
+        """High-water mark of each verify queue's depth since the last
+        peak snapshot (ISSUE 14 satellite): the committed bench artifact
+        and the scrape both want peak backlog, not the instantaneous
+        gauge that misses every burst between samples.  ``reset`` rearms
+        the mark at the CURRENT depth.  Called from scrape threads: the
+        read and the rearm store are each GIL-atomic; a burst landing
+        between them is picked up by the next snapshot (never torn,
+        possibly attributed one window late — the same benign race the
+        loop-confined metrics reads accept)."""
+        out: Dict[str, int] = {}
+        for name, q in dict(self._queues).items():
+            out[name] = max(q.peak_depth, len(q.pending))
+            if reset:
+                q.peak_depth = len(q.pending)  # noqa: LD001
+        return out
+
+    def sign_queue_depth_peaks(self, reset: bool = True) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for name, q in dict(self._sign_queues).items():
+            out[name] = max(q.peak_depth, len(q.pending))
+            if reset:
+                q.peak_depth = len(q.pending)  # noqa: LD001
+        return out
 
     def _sharded(self, name: str, builder):
         # Dispatchers run on worker threads (max_inflight > 1): lock the

@@ -12,6 +12,7 @@ flags layer over ``PEER_*`` environment variables (root.go:73-82).
     python -m minbft_tpu.sample.peer --keys keys.yaml --config consensus.yaml request "op"
     python -m minbft_tpu.sample.peer selftest   # in-process n=4 smoke test
     python -m minbft_tpu.sample.peer metrics 127.0.0.1:9464   # scrape
+    python -m minbft_tpu.sample.peer top 127.0.0.1:9464 ...   # live console
     # `run --metrics-port N` serves Prometheus text (stdlib HTTP, no
     # aiohttp); MINBFT_TRACE_DUMP=path turns the flight recorder on and
     # dumps per-request stage spans at shutdown (README §Observability).
@@ -251,6 +252,39 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         "aggregate, not the per-target sections",
     )
 
+    tp = sub.add_parser(
+        "top",
+        help="live cluster console: watch replica --metrics-port "
+        "endpoints and render per-replica/per-group req/s, batch fill, "
+        "device utilization, queue depth, loop lag, view, and health "
+        "flags (commit stall / stale group).  Watch mode diffs "
+        "consecutive scrapes; --once renders a single frame from the "
+        "minbft_window_* gauges (CI-friendly).",
+    )
+    tp.add_argument(
+        "addr",
+        nargs="+",
+        help="host:port (or full URL) of each replica's metrics endpoint",
+    )
+    tp.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in watch mode (seconds)",
+    )
+    tp.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (rc=1 if any target is down)",
+    )
+    tp.add_argument("--timeout", type=float, default=5.0)
+    tp.add_argument(
+        "--no-clear", action="store_true",
+        help="append frames instead of clearing the screen",
+    )
+    tp.add_argument(
+        "--stall-flag", action="store_true",
+        help="exit 3 when any replica reports a commit stall or stale "
+        "group (alerting hook for scripts)",
+    )
+
     q = sub.add_parser("request", help="submit request(s) as a client")
     q.add_argument("ops", nargs="*", help="operations (default: stdin lines)")
     q.add_argument(
@@ -477,6 +511,28 @@ async def _run_replica(args) -> int:
     if engine is not None and os.environ.get(obs_trace.TRACE_DUMP_ENV):
         engine.enable_obs_ring()
 
+    # Telemetry rings (obs/timeseries.py): sampled whenever anyone can
+    # read them — the Prometheus endpoint (minbft_window_* gauges feed
+    # `peer top --once`) or the trace-dump surface ({base}.rN.ts.json).
+    # Without either consumer the sampler stays off: no tick task, zero
+    # steady-state cost (the disabled-path A/B test pins this).
+    tseries = sampler = None
+    if args.metrics_port >= 0 or os.environ.get(obs_trace.TRACE_DUMP_ENV):
+        from ...obs import timeseries as obs_ts
+
+        tseries = obs_ts.TimeSeries()
+        sampler = obs_ts.CounterSampler(tseries)
+        if grouped:
+            for core in replica.cores:
+                obs_ts.register_replica_series(
+                    sampler, core.metrics, group=core.group
+                )
+        else:
+            obs_ts.register_replica_series(sampler, replica.metrics)
+        if engine is not None:
+            # once per engine — the grouped cores share it
+            obs_ts.register_engine_series(sampler, engine)
+
     metrics_server = None
     if args.metrics_port >= 0:
         from ...obs import prom as obs_prom
@@ -488,7 +544,8 @@ async def _run_replica(args) -> int:
             def render() -> str:
                 return obs_prom.render_families(
                     obs_prom.collect_group_runtime(
-                        replica, engine=engine, replica_id=args.id
+                        replica, engine=engine, replica_id=args.id,
+                        timeseries=tseries,
                     )
                 )
 
@@ -500,6 +557,7 @@ async def _run_replica(args) -> int:
                         recorder=replica.handlers.trace,
                         engine=engine,
                         replica_id=args.id,
+                        timeseries=tseries,
                     )
                 )
 
@@ -543,6 +601,20 @@ async def _run_replica(args) -> int:
         with open(f"{base}.engine{args.id}.json", "w") as fh:
             _json.dump(doc, fh)
 
+    def dump_ts() -> None:
+        # The saturation timeline rides the same dump surface as the
+        # trace files ({base}.r{id}.ts.json; kind="timeseries" keeps the
+        # trace loaders' shared glob safe).  The "id" stamp is what the
+        # merge's incarnation refusal keys on.
+        base = os.environ.get(obs_trace.TRACE_DUMP_ENV)
+        if tseries is None or not base:
+            return
+        from ...obs import timeseries as obs_ts
+
+        obs_ts.dump_timeseries(
+            tseries, f"{base}.r{args.id}", extra={"id": args.id}
+        )
+
     async def log_metrics() -> None:
         import json as _json
 
@@ -569,6 +641,20 @@ async def _run_replica(args) -> int:
     metrics_task = (
         loop.create_task(log_metrics()) if args.metrics_interval > 0 else None
     )
+    sampler_task = (
+        loop.create_task(sampler.run()) if sampler is not None else None
+    )
+
+    async def stop_sampler() -> None:
+        # Cancel-and-await: the sampler's CancelledError handler flushes
+        # the final partial interval before the ring is dumped/rendered.
+        if sampler_task is not None:
+            sampler_task.cancel()
+            try:
+                await sampler_task
+            except asyncio.CancelledError:
+                pass
+
     try:
         await stop.wait()
     except BaseException:
@@ -578,18 +664,22 @@ async def _run_replica(args) -> int:
         # dumps) and engine-span dump, then let the error propagate.
         print(f"replica {args.id} crashing: dumping trace", file=sys.stderr)
         try:
+            await stop_sampler()
             await replica.stop()
             dump_engine_obs()
+            dump_ts()
         except Exception:  # noqa: BLE001 - forensics must not mask the
             pass  # original fatal error
         raise
     if metrics_task is not None:
         metrics_task.cancel()
+    await stop_sampler()
     print(f"replica {args.id} shutting down", file=sys.stderr)
     if metrics_server is not None:
         metrics_server.stop()
     await replica.stop()  # writes the replica's MINBFT_TRACE_DUMP file
     dump_engine_obs()
+    dump_ts()
     await server.stop()
     await conn.close()
     return 0
@@ -1090,6 +1180,196 @@ def _run_metrics_scrape(args) -> int:
     return rc
 
 
+def _scrape_top_state(addr: str, timeout: float) -> dict:
+    """One target's parsed state for the ``peer top`` console: per-
+    (replica, group) identity rows plus process-level engine readings,
+    all extracted from the standard exposition families."""
+    import time as _time
+
+    from ...obs.prom import parse_exposition, scrape
+
+    fams = parse_exposition(scrape(addr, timeout=timeout))
+
+    def samples(name: str) -> dict:
+        fam = fams.get(name)
+        return fam["samples"] if fam else {}
+
+    def total(name: str) -> float:
+        return float(sum(samples(name).values()))
+
+    def by_identity(name: str) -> dict:
+        out = {}
+        for key, v in samples(name).items():
+            lb = dict(key)
+            out[(lb.get("replica", "?"), lb.get("group", "-"))] = v
+        return out
+
+    state = {
+        "addr": addr,
+        "mono": _time.monotonic(),
+        "executed": by_identity("minbft_requests_executed_total"),
+        "view": by_identity("minbft_health_view"),
+        "stall": by_identity("minbft_health_commit_stall"),
+        "stale": by_identity("minbft_health_stale_group"),
+        "vchanges": by_identity("minbft_view_changes_completed_total"),
+        "build": {},
+        "depth": total("minbft_verify_queue_depth")
+        + total("minbft_sign_queue_depth"),
+        "peak": total("minbft_verify_queue_depth_peak")
+        + total("minbft_sign_queue_depth_peak"),
+        "device_s": total("minbft_verify_queue_device_seconds_total")
+        + total("minbft_sign_queue_device_seconds_total"),
+        "items": total("minbft_verify_queue_items_total"),
+        "batches": total("minbft_verify_queue_batches_total"),
+        "uptime": max(
+            samples("minbft_uptime_seconds").values(), default=0.0
+        ),
+        "window": {},
+    }
+    for key, _v in samples("minbft_build_info").items():
+        lb = dict(key)
+        state["build"][(lb.get("replica", "?"), lb.get("group", "-"))] = lb
+    for name, fam in fams.items():
+        if name.startswith("minbft_window_"):
+            state["window"][name[len("minbft_window_"):]] = next(
+                iter(fam["samples"].values()), 0.0
+            )
+    return state
+
+
+def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
+    """Render one console frame: header + one row per (replica, group)
+    identity per target, DOWN rows for unreachable targets.  Returns
+    ``(lines, unhealthy)`` — unhealthy when any row flags a commit
+    stall or stale group (the --stall-flag exit hook)."""
+    lines = [
+        f"{'TARGET':<24}{'R':>3}{'G':>3}{'REQ/S':>9}{'FILL':>7}"
+        f"{'UTIL%':>7}{'DEPTH':>7}{'PEAK':>6}{'LAG_MS':>8}{'VIEW':>5}"
+        "  HEALTH"
+    ]
+    unhealthy = False
+    for addr in sorted(set(states) | set(errors)):
+        if addr in errors:
+            lines.append(f"{addr:<24}{'—':>3}{'—':>3}  DOWN: {errors[addr]}")
+            continue
+        st = states[addr]
+        pv = prev.get(addr)
+        dt = (st["mono"] - pv["mono"]) if pv else 0.0
+
+        def rate(cur: float, last: float, window_key: str) -> float:
+            # watch mode: counter delta over the scrape gap; first
+            # frame / --once: the server-side window gauge, falling
+            # back to the lifetime mean when rings are off.
+            if pv is not None and dt > 0 and cur >= last:
+                return (cur - last) / dt
+            if window_key in st["window"]:
+                return st["window"][window_key]
+            return cur / st["uptime"] if st["uptime"] > 0 else 0.0
+
+        # Process-level engine readings (shared across the target's rows).
+        if pv is not None and dt > 0 and st["device_s"] >= pv["device_s"]:
+            util = 100.0 * (st["device_s"] - pv["device_s"]) / dt
+        else:
+            util = (
+                100.0 * st["device_s"] / st["uptime"]
+                if st["uptime"] > 0
+                else 0.0
+            )
+        if (
+            pv is not None
+            and st["batches"] > pv["batches"]
+            and st["items"] >= pv["items"]
+        ):
+            fill = (st["items"] - pv["items"]) / (
+                st["batches"] - pv["batches"]
+            )
+        elif "verify_fill" in st["window"]:
+            fill = st["window"]["verify_fill"]
+        else:
+            fill = st["items"] / st["batches"] if st["batches"] else 0.0
+        identities = sorted(
+            set(st["executed"]) | set(st["build"]) | set(st["view"])
+        )
+        if not identities:
+            identities = [("?", "-")]
+        for rid, grp in identities:
+            ident = (rid, grp)
+            executed = st["executed"].get(ident, 0.0)
+            win_key = (
+                f"committed_g{grp}" if grp != "-" else "committed"
+            )
+            rps = rate(
+                executed,
+                pv["executed"].get(ident, 0.0) if pv else 0.0,
+                win_key,
+            )
+            lag_key = (
+                f"loop_lag_p50_ms_g{grp}" if grp != "-"
+                else "loop_lag_p50_ms"
+            )
+            lag = st["window"].get(lag_key, 0.0)
+            flags = []
+            if st["stall"].get(ident):
+                flags.append("STALL")
+                unhealthy = True
+            if st["stale"].get(ident):
+                flags.append("STALE")
+                unhealthy = True
+            vc = st["vchanges"].get(ident, 0)
+            if vc:
+                flags.append(f"vc={int(vc)}")
+            view = int(st["view"].get(ident, 0))
+            lines.append(
+                f"{addr:<24}{rid:>3}{grp:>3}{rps:>9.1f}{fill:>7.1f}"
+                f"{min(util, 999.0):>7.1f}{st['depth']:>7.0f}"
+                f"{st['peak']:>6.0f}{lag:>8.2f}{view:>5}"
+                f"  {' '.join(flags) or 'ok'}"
+            )
+        build = next(iter(st["build"].values()), None)
+        if build is not None:
+            lines.append(
+                f"{'':<24} └ pid={build.get('pid', '?')} "
+                f"backend={build.get('backend', '?')} "
+                f"rev={build.get('git_rev', '?')} "
+                f"run={str(build.get('run_id', '?'))[:18]}"
+            )
+    return lines, unhealthy
+
+
+def _run_top(args) -> int:
+    """``peer top`` — the live cluster console (ISSUE 14).  Watch mode
+    clears and redraws every ``--interval`` seconds, computing rates
+    from consecutive-scrape counter deltas; ``--once`` prints a single
+    frame whose rates come from the replicas' own ``minbft_window_*``
+    gauges (one scrape, no diffing — the CI/scripting mode)."""
+    import time as _time
+
+    prev: dict = {}
+    while True:
+        states: dict = {}
+        errors: dict = {}
+        for addr in args.addr:
+            try:
+                states[addr] = _scrape_top_state(addr, args.timeout)
+            except OSError as e:
+                errors[addr] = str(e)
+        lines, unhealthy = _top_frame(states, errors, prev)
+        if not args.once and not args.no_clear and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print("\n".join(lines), flush=True)
+        if args.once:
+            if errors:
+                return 1
+            if args.stall_flag and unhealthy:
+                return 3
+            return 0
+        prev = states
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None) -> int:
     path, explicit = peek_options_path(argv)
     args = build_parser(load_peer_options(path, explicit)).parse_args(argv)
@@ -1103,6 +1383,8 @@ def main(argv=None) -> int:
         return asyncio.run(_run_replica(args))
     if args.command == "metrics":
         return _run_metrics_scrape(args)
+    if args.command == "top":
+        return _run_top(args)
     if args.command == "request":
         return asyncio.run(_run_request(args))
     if args.command == "bench":

@@ -110,9 +110,39 @@ class ReplicaMetrics:
         # the critical-path loop_lag segment.
         self.loop_lag = Log2Histogram()
         self._started = time.monotonic()
+        # Health-monitor state (ISSUE 14): monotonic stamps of the last
+        # executed request and the last handled message, plus the
+        # replica's current view.  A commit stall is "messages keep
+        # arriving but nothing has executed for > T" — computable from
+        # these two stamps by any stateless scrape, no detector thread.
+        self.last_executed_mono = 0.0
+        self.last_message_mono = 0.0
+        self.current_view = 0
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
+        # Stall-detector stamps inline with the two counters that define
+        # progress (one string compare each on the hot path; the obs
+        # overhead A/B test bounds the cost).
+        if name == "requests_executed":
+            self.last_executed_mono = time.monotonic()
+        elif name == "messages_handled":
+            self.last_message_mono = time.monotonic()
+
+    def note_view(self, view: int) -> None:
+        """Record the view this replica currently operates in (called
+        from the new-view apply path; scraped as minbft_health_view)."""
+        self.current_view = view
+
+    def stalled(self, after_s: float = 30.0) -> bool:
+        """Commit-stall detector: True when messages arrived more
+        recently than the last execution AND nothing has executed for
+        ``after_s`` — traffic without progress.  An idle replica (no
+        traffic either) is healthy, not stalled."""
+        if self.last_message_mono <= self.last_executed_mono:
+            return False
+        ref = self.last_executed_mono or self._started
+        return time.monotonic() - ref > after_s
 
     def observe_execute(self, seconds: float) -> None:
         self.execute_latency.observe(seconds)

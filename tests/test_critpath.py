@@ -382,3 +382,38 @@ def test_grouped_dumps_pair_within_groups_only():
         exp_total / 1e6, rel=0.01
     )
     assert "t_critpath_negative_spans" not in table_all
+
+
+def test_incarnation_refusal_drops_restarted_identities():
+    """ISSUE 14 satellite: a restarted replica keeps its id but is a new
+    process (fresh run_id) whose (cid, seq) keys can collide with its
+    predecessor's — the merge must drop BOTH incarnations of that
+    identity (it cannot know which events belong to whom), count them in
+    ``refused_docs``, and still stitch the surviving replicas."""
+    import copy
+
+    docs, truth = synth_docs(domains=["h"] * 4, client_domain="h")
+    for d in docs:
+        if d["kind"] != "engine":
+            d["run_id"] = "1000-1"
+    ghost = copy.deepcopy(
+        next(d for d in docs if d["kind"] == "replica" and d["id"] == 3)
+    )
+    ghost["run_id"] = "2000-2"  # the restart
+    merged = docs + [ghost]
+    res = critpath.cluster_paths(merged)
+    assert res.refused_docs == 2  # both incarnations of replica 3
+    assert len(res.paths) == len(truth)  # 3 replicas still quorate
+    table = critpath.critpath_table(merged, "t")
+    assert table["t_critpath_refused_docs"] == 2
+    assert table["t_critpath_requests"] == len(truth)
+    # no conflict -> the key is ABSENT, not 0 (the stage_table contract:
+    # only-when-nonzero sanity counters)
+    clean = critpath.critpath_table(docs, "t")
+    assert "t_critpath_refused_docs" not in clean
+    # a stamped doc meeting an unstamped doc of the same identity is
+    # indistinguishable from a restart: refused too
+    unstamped = copy.deepcopy(ghost)
+    del unstamped["run_id"]
+    res2 = critpath.cluster_paths(docs + [unstamped])
+    assert res2.refused_docs == 2

@@ -724,3 +724,171 @@ def test_grouped_cluster_commits_over_real_processes(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+def _metrics_port(log_path: str) -> int:
+    import re
+
+    assert _wait_for_log([log_path], b"/metrics", 30), (
+        f"{log_path} never announced its metrics endpoint"
+    )
+    return int(
+        re.search(
+            rb"metrics on http://[^:]+:(\d+)/metrics",
+            open(log_path, "rb").read(),
+        ).group(1)
+    )
+
+
+def test_peer_top_once_renders_live_ungrouped_cluster(tmp_path):
+    """Acceptance (ISSUE 14): `peer top --once` against a real n=4
+    `peer run --metrics-port` cluster renders the console header plus
+    one healthy row and a build-attribution line per target, exit 0."""
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    d = str(tmp_path)
+    base_port = _free_base_port(4)
+
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "4", "-d", d, "--base-port", str(base_port),
+         "--usig", "SOFT_ECDSA"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = []
+    logs = []
+    try:
+        for i in range(4):
+            log = open(f"{d}/replica{i}.log", "wb")
+            logs.append(log)
+            replicas.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "minbft_tpu.sample.peer",
+                     "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+                     "run", str(i), "--no-batch", "--metrics-port", "0"],
+                    env=env, stdout=subprocess.DEVNULL, stderr=log,
+                )
+            )
+        assert _wait_ports([base_port + i for i in range(4)]), "never bound"
+        mports = [_metrics_port(f"{d}/replica{i}.log") for i in range(4)]
+
+        req = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "top-op", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert req.returncode == 0, req.stderr
+
+        addrs = [f"127.0.0.1:{p}" for p in mports]
+        top = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "top", "--once", *addrs],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert top.returncode == 0, top.stderr + top.stdout
+        out = top.stdout
+        assert "TARGET" in out and "REQ/S" in out and "HEALTH" in out
+        for i, addr in enumerate(addrs):
+            assert addr in out, out
+            # the replica's identity row renders healthy in view 0
+            row = next(ln for ln in out.splitlines() if ln.startswith(addr))
+            assert row.rstrip().endswith("ok"), row
+            rid, grp = row[24:30].split()
+            assert rid == str(i) and grp == "-", row
+        # one attribution line per target
+        assert out.count("└ pid=") == 4, out
+        assert "backend=" in out and "run=" in out
+
+        # --stall-flag on a healthy cluster still exits 0; a dead target
+        # renders DOWN and exits 1 (the CI contract)
+        dead = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "top", "--once", "127.0.0.1:1"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert dead.returncode == 1, dead.stdout
+        assert "DOWN" in dead.stdout
+    finally:
+        for p in replicas:
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
+
+
+def test_peer_top_once_renders_live_grouped_cluster(tmp_path):
+    """Acceptance (ISSUE 14), grouped flavor: each `peer run` process
+    hosts G=2 consensus groups, so every target renders one row PER
+    GROUP (the stale-group and per-group committed gauges are per-core)
+    — `peer top --once` must show both group identities per process."""
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    d = str(tmp_path)
+    base_port = _free_base_port(4)
+
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "4", "-d", d, "--base-port", str(base_port),
+         "--usig", "SOFT_ECDSA", "--groups", "2"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = []
+    logs = []
+    try:
+        for i in range(4):
+            log = open(f"{d}/replica{i}.log", "wb")
+            logs.append(log)
+            replicas.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "minbft_tpu.sample.peer",
+                     "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+                     "run", str(i), "--no-batch", "--metrics-port", "0"],
+                    env=env, stdout=subprocess.DEVNULL, stderr=log,
+                )
+            )
+        assert _wait_ports([base_port + i for i in range(4)]), "never bound"
+        mports = [_metrics_port(f"{d}/replica{i}.log") for i in range(4)]
+
+        req = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "grouped-top-op", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert req.returncode == 0, req.stderr
+
+        addrs = [f"127.0.0.1:{p}" for p in mports]
+        top = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "top", "--once", *addrs],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert top.returncode == 0, top.stderr + top.stdout
+        out = top.stdout
+        for addr in addrs:
+            rows = [ln for ln in out.splitlines() if ln.startswith(addr)]
+            assert len(rows) == 2, (addr, rows, out)  # one row per group
+            groups = set()
+            for row in rows:
+                assert row.rstrip().endswith("ok"), row
+                groups.add(row[24:30].split()[-1])
+            assert groups == {"0", "1"}, rows
+        assert out.count("└ pid=") == 4, out
+    finally:
+        for p in replicas:
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -1,0 +1,341 @@
+"""Telemetry-ring tests (minbft_tpu/obs/timeseries.py, ISSUE 14): slot
+semantics on the absolute epoch grid, exact/associative merge (the
+Log2Histogram contract), counter-delta discipline under resets, the
+multi-producer hammer the lock-discipline analyzer assumes, and the
+dump→merge incarnation refusal."""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from minbft_tpu.obs.timeseries import (
+    CounterSampler,
+    IncarnationMismatch,
+    TimeSeries,
+    dump_timeseries,
+    merge_timeseries_docs,
+)
+
+# A fixed epoch anchor far from "now" so tests never race the wall
+# clock's interval boundary: every record passes an explicit t.
+T0 = 1_000_000.0
+
+
+# ---------------------------------------------------------------------------
+# slot semantics
+
+
+def test_rate_sums_and_gauge_means_within_a_slot():
+    ts = TimeSeries(interval_s=1.0)
+    for v in (1.0, 2.0, 3.0):
+        ts.record("c", v, kind="rate", t=T0 + 0.2)
+        ts.record("d", v, kind="gauge", t=T0 + 0.2)
+    idx = ts.index_for(T0)
+    assert ts.value("c", idx) == 6.0  # rate: deltas add
+    assert ts.value("d", idx) == 2.0  # gauge: mean of samples
+    assert ts.value("c", idx + 1) == 0.0  # empty slot reads 0
+    assert ts.kind("c") == "rate" and ts.kind("d") == "gauge"
+
+
+def test_kind_is_fixed_at_first_record():
+    ts = TimeSeries()
+    ts.record("c", 1.0, kind="rate", t=T0)
+    with pytest.raises(ValueError, match="cannot record"):
+        ts.record("c", 1.0, kind="gauge", t=T0)
+    with pytest.raises(ValueError, match="kind must be"):
+        ts.record("e", 1.0, kind="bogus", t=T0)
+
+
+def test_constructor_rejects_degenerate_grids():
+    with pytest.raises(ValueError):
+        TimeSeries(interval_s=0.0)
+    with pytest.raises(ValueError):
+        TimeSeries(capacity=0)
+
+
+def test_window_excludes_the_still_filling_interval():
+    """window() must not read the newest slot: a half-elapsed interval
+    would report a half rate."""
+    ts = TimeSeries(interval_s=1.0)
+    for k in range(5):
+        ts.record("c", 10.0, kind="rate", t=T0 + k)
+        ts.record("g", float(k), kind="gauge", t=T0 + k)
+    now = T0 + 4.5  # slot T0+4 is still filling
+    w = ts.window(3.0, now=now)
+    # slots T0+1..T0+3 → 30 units over 3 s
+    assert w["c"] == pytest.approx(10.0)
+    assert w["g"] == pytest.approx((1 + 2 + 3) / 3)
+    # empty window reads 0, not a crash
+    w_empty = ts.window(3.0, now=T0 - 100)
+    assert w_empty["c"] == 0.0 and w_empty["g"] == 0.0
+
+
+def test_timeline_fills_gaps_with_zero_and_honors_last():
+    ts = TimeSeries(interval_s=1.0)
+    base = ts.index_for(T0)
+    ts.record("c", 5.0, kind="rate", t=T0)
+    ts.record("c", 7.0, kind="rate", t=T0 + 3)
+    start, vals = ts.timeline("c")
+    assert start == base
+    assert vals == [5.0, 0.0, 0.0, 7.0]
+    start2, vals2 = ts.timeline("c", last=2)
+    assert start2 == base + 2
+    assert vals2 == [0.0, 7.0]
+    assert ts.timeline("missing") == (0, [])
+
+
+def test_capacity_prunes_from_the_newest_index():
+    ts = TimeSeries(interval_s=1.0, capacity=10)
+    for k in range(30):
+        ts.record("c", 1.0, kind="rate", t=T0 + k)
+    start, vals = ts.timeline("c")
+    assert len(vals) <= 10
+    assert start >= ts.index_for(T0 + 29) - 10
+    # a late straggler older than the floor cannot resurrect history
+    ts.record("c", 1.0, kind="rate", t=T0)
+    ts.record("c", 1.0, kind="rate", t=T0 + 30)
+    start3, _ = ts.timeline("c")
+    assert start3 > ts.index_for(T0)
+
+
+# ---------------------------------------------------------------------------
+# merge: exact, associative, refuses mismatched grids/kinds
+
+
+def _random_ring(seed: int) -> TimeSeries:
+    rng = random.Random(seed)
+    ts = TimeSeries(interval_s=1.0)
+    for _ in range(rng.randrange(5, 40)):
+        name = rng.choice(["a", "b", "g"])
+        kind = "gauge" if name == "g" else "rate"
+        t = T0 + rng.randrange(0, 20)
+        for _ in range(rng.randrange(1, 4)):
+            ts.record(name, rng.uniform(0, 100), kind=kind, t=t)
+    return ts
+
+
+def _copy(ts: TimeSeries) -> TimeSeries:
+    return TimeSeries.from_dict(ts.to_dict())
+
+
+def test_merge_is_exact_pair_addition():
+    a, b = _random_ring(1), _random_ring(2)
+    merged = _copy(a).merge(_copy(b))
+    da, db, dm = a.to_dict(), b.to_dict(), merged.to_dict()
+    names = set(da["series"]) | set(db["series"])
+    assert set(dm["series"]) == names
+    for name in names:
+        pa = (da["series"].get(name) or {"points": {}})["points"]
+        pb = (db["series"].get(name) or {"points": {}})["points"]
+        pm = dm["series"][name]["points"]
+        assert set(pm) == set(pa) | set(pb)
+        for i in pm:
+            s = (pa.get(i, [0, 0])[0] + pb.get(i, [0, 0])[0])
+            n = (pa.get(i, [0, 0])[1] + pb.get(i, [0, 0])[1])
+            assert pm[i][0] == pytest.approx(s)
+            assert pm[i][1] == n
+
+
+def test_merge_is_associative_slot_for_slot():
+    for seed in range(4):
+        a = _random_ring(3 * seed)
+        b = _random_ring(3 * seed + 1)
+        c = _random_ring(3 * seed + 2)
+        left = _copy(a).merge(_copy(b)).merge(_copy(c))
+        right = _copy(a).merge(_copy(b).merge(_copy(c)))
+        assert left.to_dict() == right.to_dict()
+
+
+def test_merge_refuses_mismatched_grids_and_kinds():
+    a = TimeSeries(interval_s=1.0)
+    with pytest.raises(ValueError, match="interval mismatch"):
+        a.merge(TimeSeries(interval_s=2.0))
+    a.record("x", 1.0, kind="rate", t=T0)
+    b = TimeSeries(interval_s=1.0)
+    b.record("x", 1.0, kind="gauge", t=T0)
+    with pytest.raises(ValueError, match="kind mismatch"):
+        a.merge(b)
+
+
+def test_dict_round_trip_preserves_readings():
+    a = _random_ring(9)
+    b = TimeSeries.from_dict(json.loads(json.dumps(a.to_dict())))
+    assert b.to_dict() == a.to_dict()
+    for name in a.names():
+        assert a.timeline(name) == b.timeline(name)
+
+
+# ---------------------------------------------------------------------------
+# concurrency: the lock class tools/analyze pins
+
+
+def test_mt_record_multi_producer_hammer():
+    """Sampler-thread-shaped hammer: several OS threads record into the
+    SAME series (and a few private ones) concurrently; no update may be
+    lost — the final (sum, n) pairs must account for every record."""
+    ts = TimeSeries(interval_s=1.0, capacity=600)
+    n_threads, per_thread = 8, 3000
+
+    def producer(tid: int) -> None:
+        for k in range(per_thread):
+            t = T0 + (k % 50)
+            ts.record("shared", 1.0, kind="rate", t=t)
+            ts.record(f"own{tid}", 2.0, kind="gauge", t=t)
+
+    threads = [
+        threading.Thread(target=producer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    _, vals = ts.timeline("shared")
+    assert sum(vals) == n_threads * per_thread  # no lost update
+    for tid in range(n_threads):
+        _, gv = ts.timeline(f"own{tid}")
+        assert all(v == 2.0 for v in gv)  # gauge mean of identical samples
+
+
+def test_record_overhead_is_bounded():
+    """Disabled-path honesty (ISSUE 14 satellite): the observability
+    hooks must stay cheap enough that leaving them wired costs nothing
+    the bench can see.  A/B a monotonic-stamped counter inc against a
+    bare dict increment, and a ring record against the same baseline —
+    thresholds are catastrophic-only (orders of magnitude) so a slow CI
+    runner cannot flake this."""
+    from minbft_tpu.utils.metrics import ReplicaMetrics
+
+    n = 20_000
+    plain = {}
+    t0 = time.perf_counter()
+    for _ in range(n):
+        plain["requests_executed"] = plain.get("requests_executed", 0) + 1
+    base = time.perf_counter() - t0
+
+    m = ReplicaMetrics()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        m.inc("requests_executed")
+    stamped = time.perf_counter() - t0
+
+    ts = TimeSeries()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ts.record("c", 1.0, kind="rate", t=T0)
+    ring = time.perf_counter() - t0
+
+    floor = max(base, 1e-4)  # guard against a 0-resolution timer
+    assert stamped < 200 * floor, (stamped, base)
+    assert ring < 400 * floor, (ring, base)
+
+
+# ---------------------------------------------------------------------------
+# CounterSampler: delta discipline
+
+
+class _Counter:
+    def __init__(self):
+        self.v = 0.0
+
+    def __call__(self):
+        return self.v
+
+
+def test_sampler_first_tick_only_baselines():
+    ts = TimeSeries(interval_s=1.0)
+    s = CounterSampler(ts)
+    c = _Counter()
+    c.v = 500  # pre-existing total at sampler start
+    s.add_rate("committed", c)
+    s.tick(t=T0)
+    assert ts.names() == []  # baseline only, no fabricated burst
+    c.v = 530
+    s.tick(t=T0 + 1)
+    assert ts.value("committed", ts.index_for(T0 + 1)) == 30.0
+
+
+def test_sampler_backwards_counter_rebaselines():
+    """A warm-up stats reset swaps a fresh counter in; the sampler must
+    read that as 'no data', never as a negative rate."""
+    ts = TimeSeries(interval_s=1.0)
+    s = CounterSampler(ts)
+    c = _Counter()
+    s.add_rate("committed", c)
+    s.tick(t=T0)
+    c.v = 100
+    s.tick(t=T0 + 1)
+    c.v = 5  # reset!
+    s.tick(t=T0 + 2)
+    c.v = 25
+    s.tick(t=T0 + 3)
+    assert ts.value("committed", ts.index_for(T0 + 1)) == 100.0
+    assert ts.value("committed", ts.index_for(T0 + 2)) == 0.0  # gap, not -95
+    assert ts.value("committed", ts.index_for(T0 + 3)) == 20.0
+    _, vals = ts.timeline("committed")
+    assert all(v >= 0 for v in vals)
+
+
+def test_sampler_ratio_skips_idle_denominator():
+    ts = TimeSeries(interval_s=1.0)
+    s = CounterSampler(ts)
+    num, den = _Counter(), _Counter()
+    s.add_ratio("fill", num, den)
+    s.tick(t=T0)
+    num.v, den.v = 12, 2
+    s.tick(t=T0 + 1)
+    s.tick(t=T0 + 2)  # denominator unmoved: gap, not a fake 0
+    num.v, den.v = 18, 4
+    s.tick(t=T0 + 3)
+    assert ts.value("fill", ts.index_for(T0 + 1)) == pytest.approx(6.0)
+    assert ts._read("fill", ts.index_for(T0 + 2)) is None
+    assert ts.value("fill", ts.index_for(T0 + 3)) == pytest.approx(3.0)
+
+
+def test_sampler_gauge_records_instantaneous_value():
+    ts = TimeSeries(interval_s=1.0)
+    s = CounterSampler(ts)
+    g = _Counter()
+    g.v = 7.0
+    s.add_gauge("depth", g)
+    s.tick(t=T0)
+    g.v = 9.0
+    s.tick(t=T0 + 1)
+    assert ts.value("depth", ts.index_for(T0)) == 7.0
+    assert ts.value("depth", ts.index_for(T0 + 1)) == 9.0
+
+
+# ---------------------------------------------------------------------------
+# dump / merge docs: the incarnation refusal
+
+
+def test_dump_and_merge_docs_round_trip(tmp_path):
+    ts = TimeSeries(interval_s=1.0)
+    ts.record("committed", 11.0, kind="rate", t=T0)
+    path = dump_timeseries(ts, str(tmp_path / "run.r0"), extra={"id": 0})
+    assert path.endswith(".ts.json")
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["kind"] == "timeseries"
+    assert doc["id"] == 0
+    assert doc["run_id"] and doc["build"]["run_id"] == doc["run_id"]
+    merged = merge_timeseries_docs([doc, doc])  # same incarnation: fine
+    idx = merged.index_for(T0)
+    assert merged.value("committed", idx) == 22.0
+
+
+def test_merge_docs_refuses_two_incarnations_of_one_id():
+    mk = lambda run: {  # noqa: E731 - tiny local fixture
+        "kind": "timeseries", "id": 3, "run_id": run,
+        "ts": TimeSeries().to_dict(),
+    }
+    with pytest.raises(IncarnationMismatch, match="two incarnations"):
+        merge_timeseries_docs([mk("111-1"), mk("111-2")])
+    # distinct ids may come from distinct incarnations (normal cluster)
+    a, b = mk("111-1"), mk("111-2")
+    b["id"] = 4
+    merge_timeseries_docs([a, b])
+    # docs of other kinds are ignored, not confused for rings
+    merge_timeseries_docs([a, {"kind": "replica", "id": 3, "run_id": "x"}])

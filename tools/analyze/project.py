@@ -324,6 +324,19 @@ def default_config() -> AnalyzeConfig:
                 locks=(),
                 guarded=("_last",),
             ),
+            # Telemetry rings (obs/timeseries.py, ISSUE 14): written by
+            # samplers on the event loop AND read/merged from the scrape
+            # thread, so every access to the slot maps goes through
+            # `with self._lock` (the MTStageRing discipline; the
+            # concurrent-writer hammer in tests/test_timeseries.py pins
+            # the no-lost-update invariant).
+            LockClassSpec(
+                path="minbft_tpu/obs/timeseries.py",
+                cls="TimeSeries",
+                locks=("_lock",),
+                guarded=("_series", "_kinds"),
+                mode="threads",
+            ),
             # Chaos fault fabric (testing/faultnet.py, ISSUE 5): ONE
             # FaultNet is shared by every wrapped endpoint's pipes on one
             # event loop.  Scripted-state flips (stall/partition/reset

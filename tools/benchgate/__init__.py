@@ -8,7 +8,10 @@ Scope — deliberately narrow and honest:
 - Gated keys are EXACTLY the ``*_req_per_sec_mean`` triples present in
   BOTH artifacts (the committed-throughput headlines; kernel rates have
   no stddev companion and single-run phases carry stddev 0.0, which the
-  relative noise floor below absorbs).
+  relative noise floor below absorbs), plus the
+  ``*_util_effective_per_sec`` utilization headlines (ISSUE 14: the
+  ledger's effective useful-lane rate — no stddev companion, so the
+  relative floor is the whole noise defense there).
 - A key regresses when its drop exceeds BOTH noise defenses:
   ``drop > max(sigmas * sqrt(base_std² + cand_std²),
   rel_floor * base_mean)`` — the stddev band covers measured run-to-run
@@ -39,6 +42,9 @@ DEFAULT_REL_FLOOR = 0.30
 
 _MEAN_SUFFIX = "_req_per_sec_mean"
 _STD_SUFFIX = "_req_per_sec_stddev"
+# Utilization headline (ISSUE 14): gated like a mean triple whose stddev
+# is 0.0 everywhere — the rel_floor absorbs single-window noise.
+_UTIL_SUFFIX = "_util_effective_per_sec"
 
 
 class BackendMismatch(Exception):
@@ -93,9 +99,15 @@ def gated_pairs(
     pairs: Dict[str, str] = {}
     missing: List[str] = []
     for key in sorted(baseline):
-        if not key.endswith(_MEAN_SUFFIX):
+        if key.endswith(_MEAN_SUFFIX):
+            prefix = key[: -len(_MEAN_SUFFIX)]
+        elif key.endswith(_UTIL_SUFFIX):
+            # report label "{config}_util"; the stddev lookup in
+            # compare() then misses by construction and reads 0.0 —
+            # exactly the single-run semantics the rel_floor covers
+            prefix = key[: -len(_UTIL_SUFFIX)] + "_util"
+        else:
             continue
-        prefix = key[: -len(_MEAN_SUFFIX)]
         if key in candidate:
             pairs[prefix] = key
         else:
