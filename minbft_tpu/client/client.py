@@ -36,6 +36,7 @@ from .. import api
 from ..obs import trace as obs_trace
 from ..utils.backoff import ReconnectBackoff, RetransmitBackoff
 from ..messages import (
+    Busy,
     CodecError,
     Reply,
     Request,
@@ -60,6 +61,7 @@ class _PendingRequest:
         "count_by_digest",
         "result",
         "data",
+        "busy_until",
     )
 
     def __init__(
@@ -86,6 +88,10 @@ class _PendingRequest:
         # Marshaled REQUEST bytes, kept so a reconnecting replica stream can
         # re-send everything still unresolved (see _run_connection).
         self.data: Optional[bytes] = None
+        # Monotonic deadline before which retransmission is suppressed —
+        # set by a verified BUSY shed signal (replica admission control).
+        # The request itself stays live: a reply still resolves it.
+        self.busy_until: float = 0.0
 
     def add_reply(self, reply: Reply) -> None:
         if reply.read_only != self.read_only:
@@ -161,6 +167,8 @@ class Client:
             if (trace or obs_trace.tracing_enabled())
             else None
         )
+        # Verified BUSY shed signals received (observable by load harnesses).
+        self.busy_signals = 0
         self._log = logging.getLogger(f"minbft_tpu.client.{client_id}")
 
     # -- connections --------------------------------------------------------
@@ -324,6 +332,9 @@ class Client:
             msg = unmarshal(data)
         except Exception:
             return
+        if isinstance(msg, Busy):
+            await self._handle_busy(replica_id, msg)
+            return
         if not isinstance(msg, Reply):
             return
         # Authenticate and attribute (reference client/message-handling.go:161-170).
@@ -355,6 +366,35 @@ class Client:
                 tr.note(obs_trace.C_FIRST_REPLY, self.client_id, msg.seq)
             if not was_done and pending.result.done():
                 tr.note(obs_trace.C_QUORUM, self.client_id, msg.seq)
+
+    async def _handle_busy(self, replica_id: int, msg: Busy) -> None:
+        """A replica shed our REQUEST at its admission boundary: verify the
+        signal (a forged BUSY must not be able to starve this client) and
+        suppress retransmission of that request for ``retry_after_ms``.
+        The pending request stays live — replies from less-loaded replicas
+        (or this one, post-recovery) still resolve it; only the re-send
+        pressure backs off."""
+        if msg.replica_id != replica_id or msg.client_id != self.client_id:
+            return
+        pending = self._pending.get(msg.seq)
+        if pending is None or pending.result.done():
+            return
+        try:
+            await self._auth.verify_message_authen_tag(
+                api.AuthenticationRole.REPLICA,
+                msg.replica_id,
+                authen_bytes(msg),
+                msg.signature,
+            )
+        except api.AuthenticationError:
+            return
+        # Re-fetch: the request may have resolved during the await.
+        pending = self._pending.get(msg.seq)
+        if pending is None:
+            return
+        hold = min(max(msg.retry_after_ms, 0), 60_000) / 1000.0
+        pending.busy_until = max(pending.busy_until, time.monotonic() + hold)
+        self.busy_signals += 1
 
     # -- requests -----------------------------------------------------------
 
@@ -554,6 +594,12 @@ class Client:
             except asyncio.TimeoutError:
                 if deadline is not None and time.monotonic() >= deadline:
                     raise
+                if time.monotonic() < pending.busy_until:
+                    # A verified BUSY hold is active: retransmitting into a
+                    # saturated replica set only deepens the overload (and
+                    # earns another shed).  Skip this tick; the ladder keeps
+                    # climbing, and the overall deadline still applies.
+                    continue
                 self._broadcast(data)
 
 

@@ -30,6 +30,7 @@ from typing import AsyncIterator, Dict, Optional
 from .. import api
 from ..messages import (
     CERTIFIED_MESSAGES,
+    Busy,
     Checkpoint,
     Commit,
     Hello,
@@ -54,6 +55,7 @@ from ..messages import (
 )
 from ..messages.codec import CodecError
 from ..messages.authen import collection_digest as authen_collection_digest
+from . import admission as admission_mod
 from . import commit as commit_mod
 from . import prepare as prepare_mod
 from . import request as request_mod
@@ -230,9 +232,10 @@ class Handlers:
 
         # --- signing / verification primitives
         def sign_message(msg) -> None:
-            # A REPLY is addressed to one client: recipient-specific
-            # schemes (MAC) key the tag to it; signature schemes ignore it.
-            audience = msg.client_id if isinstance(msg, Reply) else -1
+            # A REPLY (or BUSY shed signal) is addressed to one client:
+            # recipient-specific schemes (MAC) key the tag to it;
+            # signature schemes ignore it.
+            audience = msg.client_id if isinstance(msg, (Reply, Busy)) else -1
             msg.signature = authenticator.generate_message_authen_tag(
                 utils.signing_role(msg), authen_bytes(msg), audience
             )
@@ -246,7 +249,7 @@ class Handlers:
             # rate never justifies a batch lane.  USIG certification is
             # untouched either way: the authenticator routes the USIG
             # role serially by design (counter-after-sign).
-            audience = msg.client_id if isinstance(msg, Reply) else -1
+            audience = msg.client_id if isinstance(msg, (Reply, Busy)) else -1
             msg.signature = await authenticator.generate_message_authen_tag_async(
                 utils.signing_role(msg), authen_bytes(msg), audience
             )
@@ -1960,12 +1963,18 @@ class _BundleIngestor:
 
     async def _ticks(self) -> None:
         rx = self._rx
+        metrics = self._handlers.metrics
         while True:
             if self._eof_pending and rx.empty():
                 return
             data = await rx.get()
             if data is _INGEST_EOF:
                 return
+            # Admission gauge: rx occupancy as this tick wakes (+1 for
+            # the frame just popped) — the saturation signal the BUSY
+            # retry-after hint scales by, and the high-water mark the
+            # overload tests assert bounded (metrics.note_admission_rx).
+            metrics.note_admission_rx(rx.qsize() + 1, rx.maxsize)
             flat: list = []
             self._split_into(data, flat)
             saw_eof = False
@@ -2202,6 +2211,16 @@ class ClientStreamHandler(api.MessageStreamHandler):
             h.log.warning("dropping client message: %s", e)
 
         proc = _ConcurrentStreamProcessor(handle_one, _drop_client)
+        # Admission boundary (ISSUE 15): when the processor's concurrency
+        # bound is exhausted, shed with a signed BUSY on out_queue instead
+        # of blocking the ingest tick (open-loop offered load would wedge
+        # the rx queue at its bound while the generator keeps pushing).
+        # MINBFT_ADMISSION=0 reverts to the blocking backpressure path.
+        if admission_mod.admission_enabled():
+            adm = admission_mod.AdmissionController(h, proc, out_queue)
+            submit_msg, submit_frame = adm.submit_msg, adm.submit
+        else:
+            submit_msg, submit_frame = proc.submit_msg, proc.submit
 
         async def consume() -> None:
             if bundle_ingest_enabled():
@@ -2214,7 +2233,7 @@ class ClientStreamHandler(api.MessageStreamHandler):
                 await _BundleIngestor(
                     h,
                     _drop_client,
-                    proc.submit_msg,
+                    submit_msg,
                     preverify=h.preverify_requests,
                 ).run(in_stream)
             else:
@@ -2225,7 +2244,7 @@ class ClientStreamHandler(api.MessageStreamHandler):
                         _drop_client(e)
                         continue
                     for fr in frames:
-                        await proc.submit(fr)
+                        await submit_frame(fr)
             await proc.drain()
             await out_queue.put(FIN)
 

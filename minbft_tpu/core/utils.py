@@ -6,6 +6,7 @@ import logging
 
 from .. import api
 from ..messages import (
+    Busy,
     Checkpoint,
     Commit,
     Hello,
@@ -31,7 +32,10 @@ def signing_role(msg: Message) -> api.AuthenticationRole:
         return api.AuthenticationRole.CLIENT
     if isinstance(
         msg,
-        (Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp, Hello),
+        (
+            Reply, Busy, ReqViewChange, Checkpoint, SnapshotReq,
+            SnapshotResp, Hello,
+        ),
     ):
         return api.AuthenticationRole.REPLICA
     raise TypeError(f"{type(msg).__name__} is not a signed message")

@@ -36,6 +36,7 @@ import logging
 from typing import AsyncIterator, Dict, List, Optional, Set, Tuple
 
 from .. import api
+from ..core.admission import AdmissionController, admission_enabled
 from ..core.message_handling import (
     _BundleIngestor,
     _ConcurrentStreamProcessor,
@@ -585,7 +586,11 @@ class _GroupBundleIngestor(_BundleIngestor):
                 # processor sheds its own messages (client retransmission
                 # heals), the shared tick loop keeps draining the other
                 # groups — the isolation contract, at the handler layer.
-                if not await st.proc.try_submit_msg(m):
+                # With admission control on, the shed is signaled (signed
+                # group-tagged BUSY) instead of silent.
+                if st.adm is not None:
+                    await st.adm.submit_msg(m)
+                elif not await st.proc.try_submit_msg(m):
                     st.h.metrics.inc("messages_dropped")
                     st.h.log.warning(
                         "group processor saturated, dropping client message"
@@ -598,7 +603,7 @@ class _GroupClientState:
     processor (exactly the trio the ungrouped ClientStreamHandler keeps
     per stream)."""
 
-    __slots__ = ("h", "turns", "proc")
+    __slots__ = ("h", "turns", "proc", "adm")
 
 
 class _GroupedClientStreamHandler(api.MessageStreamHandler):
@@ -659,6 +664,16 @@ class _GroupedClientStreamHandler(api.MessageStreamHandler):
                     _h.log.warning("dropping client message: %s", e)
 
                 st.proc = _ConcurrentStreamProcessor(handle_one, _drop)
+                st.adm = (
+                    AdmissionController(
+                        st.h,
+                        st.proc,
+                        out_queue,
+                        wrap=lambda b, _gid=gid: pack_group(_gid, b),
+                    )
+                    if admission_enabled()
+                    else None
+                )
                 states[gid] = st
             return st
 
@@ -692,7 +707,9 @@ class _GroupedClientStreamHandler(api.MessageStreamHandler):
                             for one in sub:
                                 # same drop-on-saturation isolation
                                 # contract as the bundle path above
-                                if not await st.proc.try_submit(one):
+                                if st.adm is not None:
+                                    await st.adm.submit(one)
+                                elif not await st.proc.try_submit(one):
                                     st.h.metrics.inc("messages_dropped")
             for st in states.values():
                 if st is not None:

@@ -1,0 +1,25 @@
+"""Open-loop load harness (ISSUE 15): seeded arrival schedules, a
+bounded-connection traffic generator measuring latency from SCHEDULED
+arrival time, and the replay-census faithfulness contract.
+
+See ``perf/LOAD.md`` for the methodology and ``peer load`` /
+``bench.py bench_load`` for the entry points.
+"""
+
+from .arrivals import (
+    Arrival,
+    LoadSpec,
+    Schedule,
+    build_schedule,
+    replay_census,
+)
+from .harness import OpenLoopGenerator
+
+__all__ = [
+    "Arrival",
+    "LoadSpec",
+    "Schedule",
+    "build_schedule",
+    "replay_census",
+    "OpenLoopGenerator",
+]

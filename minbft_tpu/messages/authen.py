@@ -27,6 +27,7 @@ import struct
 
 from . import codec
 from .message import (
+    Busy,
     Checkpoint,
     Commit,
     Hello,
@@ -88,6 +89,16 @@ def _authen_bytes(m: Message) -> bytes:
             + bytes([1 if m.read_only else 0])
             + bytes([1 if m.error else 0])
             + _sha256(m.result)
+        )
+    if isinstance(m, Busy):
+        # retry_after_ms is covered: an adversary rewriting the hint could
+        # inflate a client's backoff into starvation.
+        return (
+            b"BUSY"
+            + _U32.pack(m.replica_id)
+            + _U32.pack(m.client_id)
+            + _U64.pack(m.seq)
+            + _U32.pack(m.retry_after_ms)
         )
     if isinstance(m, Prepare):
         # Covers every embedded request *with* its client signature (in

@@ -27,6 +27,7 @@ import numpy as np
 from .message import (
     CERTIFIED_MESSAGES,
     UI,
+    Busy,
     Checkpoint,
     Commit,
     Hello,
@@ -55,6 +56,7 @@ _TAG_CHECKPOINT = 0x09
 _TAG_LOG_BASE = 0x0A
 _TAG_SNAPSHOT_REQ = 0x0B
 _TAG_SNAPSHOT_RESP = 0x0C
+_TAG_BUSY = 0x0D
 # Transport-level container: several messages coalesced into ONE stream
 # frame (amortizes the per-frame gRPC/asyncio cost, which dominates the
 # multi-process deployment's throughput on small hosts).  Deliberately far
@@ -178,6 +180,15 @@ def marshal(m: Message) -> bytes:
             + bytes([1 if m.read_only else 0])
             + bytes([1 if m.error else 0])
             + _pack_bytes(m.result)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, Busy):
+        return (
+            bytes([_TAG_BUSY])
+            + _pack_u32(m.replica_id)
+            + _pack_u32(m.client_id)
+            + _pack_u64(m.seq)
+            + _pack_u32(m.retry_after_ms)
             + _pack_bytes(m.signature)
         )
     if isinstance(m, Prepare):
@@ -371,6 +382,22 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
                 signature=sig,
                 read_only=bool(rb),
                 error=bool(eb),
+            ),
+            off,
+        )
+    if tag == _TAG_BUSY:
+        rid, off = _read_u32(data, off)
+        cid, off = _read_u32(data, off)
+        seq, off = _read_u64(data, off)
+        retry, off = _read_u32(data, off)
+        sig, off = _read_bytes(data, off)
+        return (
+            Busy(
+                replica_id=rid,
+                client_id=cid,
+                seq=seq,
+                retry_after_ms=retry,
+                signature=sig,
             ),
             off,
         )

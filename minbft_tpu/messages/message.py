@@ -143,6 +143,27 @@ class Reply(Message):
     error: bool = False
 
 
+@dataclasses.dataclass
+class Busy(Message):
+    """Replica's signed admission-shed signal to a client (ISSUE 15).
+
+    Emitted instead of silence when the replica sheds an inbound REQUEST
+    at the admission boundary (rx queue saturated / stream processor out
+    of permits).  Signed like a Reply so a network adversary cannot forge
+    backoff and starve a client; ``retry_after_ms`` is a hint scaled by
+    the observed rx saturation, honored by the client's RetransmitBackoff
+    (retransmits are suppressed until the hold expires, the pending
+    request itself stays live).
+    """
+
+    KIND = "BUSY"
+    replica_id: int
+    client_id: int
+    seq: int
+    retry_after_ms: int
+    signature: bytes = b""
+
+
 @dataclasses.dataclass(init=False)
 class Prepare(Message):
     """Primary's ordering proposal for a **batch** of requests, certified by
@@ -400,8 +421,8 @@ class SnapshotResp(Message):
 
 CLIENT_MESSAGES = (Request,)
 REPLICA_MESSAGES = (
-    Reply, Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint,
-    LogBase, SnapshotReq, SnapshotResp,
+    Reply, Busy, Prepare, Commit, ReqViewChange, ViewChange, NewView,
+    Checkpoint, LogBase, SnapshotReq, SnapshotResp,
 )
 PEER_MESSAGES = (
     Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint,
@@ -409,7 +430,8 @@ PEER_MESSAGES = (
 )
 CERTIFIED_MESSAGES = (Prepare, Commit, ViewChange, NewView)  # carry a USIG UI
 SIGNED_MESSAGES = (
-    Request, Reply, ReqViewChange, Checkpoint, SnapshotReq, SnapshotResp,
+    Request, Reply, Busy, ReqViewChange, Checkpoint, SnapshotReq,
+    SnapshotResp,
 )  # carry a plain signature
 
 # The kinds that may enter a per-peer UNICAST log (forwarded starved

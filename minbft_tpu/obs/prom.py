@@ -213,6 +213,48 @@ def collect_replica(
                     [(base, lag_hist)],
                 )
             )
+        # Admission-control state (ISSUE 15): the ingest rx queue's
+        # last-stamped occupancy, bound, high-water mark, and the derived
+        # saturation fraction.  The companion shed counters
+        # (minbft_admission_shed_total / minbft_admission_busy_sent_total
+        # / minbft_admission_busy_suppressed_total) ride the counter loop
+        # above.  Families appear once the ingestor has stamped at least
+        # one tick (bound > 0) — an idle replica stays quiet.
+        if getattr(metrics, "admission_rx_bound", 0):
+            fams.append(
+                (
+                    "minbft_admission_rx_depth",
+                    "gauge",
+                    "ingest rx queue occupancy at the last ingest tick",
+                    [(base, int(metrics.admission_rx_depth))],
+                )
+            )
+            fams.append(
+                (
+                    "minbft_admission_rx_bound",
+                    "gauge",
+                    "ingest rx queue capacity (frames)",
+                    [(base, int(metrics.admission_rx_bound))],
+                )
+            )
+            fams.append(
+                (
+                    "minbft_admission_rx_peak",
+                    "gauge",
+                    "ingest rx queue high-water mark (bounded-queue-growth "
+                    "witness for the overload tests)",
+                    [(base, int(metrics.admission_rx_peak))],
+                )
+            )
+            fams.append(
+                (
+                    "minbft_admission_rx_saturation",
+                    "gauge",
+                    "rx fill fraction in [0,1] — scales the BUSY "
+                    "retry-after hint",
+                    [(base, round(metrics.admission_rx_saturation(), 4))],
+                )
+            )
         # Health monitors (ISSUE 14): evaluated AT SCRAPE TIME from the
         # metrics' stamps — no detector thread to die silently.
         if hasattr(metrics, "current_view"):

@@ -336,6 +336,64 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         "--tag", default="", help="payload tag (keeps concurrent procs' ops distinct)"
     )
 
+    ld = sub.add_parser(
+        "load",
+        help="open-loop load run against a self-contained local cluster "
+        "(minbft_tpu/loadgen): seeded arrival schedule at a FIXED offered "
+        "rate over real loopback TCP, latency measured from scheduled "
+        "arrival time, one JSON report line (README §Load testing)",
+    )
+    ld.add_argument(
+        "--rate", type=float, default=200.0,
+        help="offered arrivals/sec (time-averaged for --process onoff)",
+    )
+    ld.add_argument("--duration", type=float, default=5.0, help="seconds")
+    ld.add_argument(
+        "--seed", type=lambda s: int(s, 0), default=1,
+        help="schedule seed (same seed = byte-identical schedule)",
+    )
+    ld.add_argument(
+        "--process", choices=("poisson", "onoff"), default="poisson",
+        help="arrival process: memoryless (default) or bursty on/off",
+    )
+    ld.add_argument(
+        "--clients", type=int, default=1000,
+        help="distinct client identities (own keys + seq spaces)",
+    )
+    ld.add_argument(
+        "--conns", type=int, default=4,
+        help="connection-pool slots; total sockets = slots x replicas",
+    )
+    ld.add_argument(
+        "--replicas", type=int, default=4, help="cluster size (f=(n-1)//3)"
+    )
+    ld.add_argument(
+        "--groups", type=int, default=1,
+        help="consensus groups (arrivals shard-routed by client key)",
+    )
+    ld.add_argument(
+        "--read-fraction", type=float, default=0.0,
+        help="fraction of arrivals on the read-only fast path",
+    )
+    ld.add_argument(
+        "--large-fraction", type=float, default=0.0,
+        help="fraction of arrivals carrying the large payload class",
+    )
+    ld.add_argument(
+        "--scheme", choices=("mac", "ecdsa-p256"), default="mac",
+        help="request auth: pairwise MACs (default — measures the "
+        "ingest/admission path, not host public-key crypto) or ECDSA",
+    )
+    ld.add_argument(
+        "--expect-goodput", type=float, default=0.0,
+        help="rc=1 unless goodput_per_sec reaches this (CI gate); with "
+        "0 (default) rc gates only on schedule faithfulness (census)",
+    )
+    ld.add_argument(
+        "--drain", type=float, default=10.0,
+        help="seconds past the last arrival to wait for stragglers",
+    )
+
     st = sub.add_parser("selftest", help="in-process n=4 cluster smoke test")
     st.add_argument(
         "--chaos-seed",
@@ -896,6 +954,67 @@ async def _run_bench_clients(args) -> int:
     os._exit(0)
 
 
+async def _run_load(args) -> int:
+    """Open-loop load run (ISSUE 15): self-contained — scaffolds its own
+    keys and in-process cluster (client traffic over real loopback TCP),
+    drives the seeded schedule, prints ONE JSON report line on stdout.
+
+    rc contract (the CI load-smoke step's interface): 0 = schedule fired
+    faithfully (live census == seed replay) and any --expect-goodput bar
+    was met; 1 otherwise.  Progress notes go to stderr."""
+    import json as _json
+
+    from ...loadgen import LoadSpec
+    from ...loadgen.runner import run_local_load
+
+    n = args.replicas
+    spec = LoadSpec(
+        seed=args.seed,
+        rate=args.rate,
+        duration_s=args.duration,
+        n_clients=args.clients,
+        process=args.process,
+        read_fraction=args.read_fraction,
+        large_fraction=args.large_fraction,
+        n_groups=args.groups,
+    )
+    spec.validate()
+    print(
+        # noqa: SH301 - a load-schedule seed is a PUBLIC replay token
+        # (printed so a run can be reproduced, same as chaos seeds), not
+        # key material.
+        f"load: seed={spec.seed:#x} {spec.process} {spec.rate}/s x "  # noqa: SH301
+        f"{spec.duration_s}s, {spec.n_clients} clients over "
+        f"{args.conns * n} sockets, n={n}",
+        file=sys.stderr,
+    )
+    report = await run_local_load(
+        spec,
+        n=n,
+        f=(n - 1) // 3,
+        pool_slots=args.conns,
+        drain_s=args.drain,
+        expect_goodput=args.expect_goodput,
+        scheme=args.scheme,
+    )
+    print(_json.dumps(report), flush=True)
+    ok = report["census_ok"] and report.get("goodput_ok", True)
+    if not report["census_ok"]:
+        print("load: FAILED — generator diverged from the seeded "
+              "schedule (census mismatch)", file=sys.stderr)
+    if not report.get("goodput_ok", True):
+        print(
+            f"load: FAILED — goodput {report['goodput_per_sec']}/s below "
+            f"the --expect-goodput {args.expect_goodput}/s bar",
+            file=sys.stderr,
+        )
+    # The report is out; a leaked replica task wedging interpreter
+    # shutdown must not turn a green run red (the `peer bench` idiom).
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0 if ok else 1)
+
+
 async def _run_selftest(args) -> int:
     """In-process n=4/f=1 commit through generated keys + the dummy
     connector — a deployment smoke test needing no files or sockets."""
@@ -1221,6 +1340,9 @@ def _scrape_top_state(addr: str, timeout: float) -> dict:
         + total("minbft_sign_queue_device_seconds_total"),
         "items": total("minbft_verify_queue_items_total"),
         "batches": total("minbft_verify_queue_batches_total"),
+        # Admission sheds (ISSUE 15): requests refused at the admission
+        # boundary — a nonzero rate means offered load exceeds capacity.
+        "shed": total("minbft_admission_shed_total"),
         "uptime": max(
             samples("minbft_uptime_seconds").values(), default=0.0
         ),
@@ -1243,9 +1365,9 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
     ``(lines, unhealthy)`` — unhealthy when any row flags a commit
     stall or stale group (the --stall-flag exit hook)."""
     lines = [
-        f"{'TARGET':<24}{'R':>3}{'G':>3}{'REQ/S':>9}{'FILL':>7}"
-        f"{'UTIL%':>7}{'DEPTH':>7}{'PEAK':>6}{'LAG_MS':>8}{'VIEW':>5}"
-        "  HEALTH"
+        f"{'TARGET':<24}{'R':>3}{'G':>3}{'REQ/S':>9}{'SHED/S':>8}"
+        f"{'FILL':>7}{'UTIL%':>7}{'DEPTH':>7}{'PEAK':>6}{'LAG_MS':>8}"
+        f"{'VIEW':>5}  HEALTH"
     ]
     unhealthy = False
     for addr in sorted(set(states) | set(errors)):
@@ -1287,6 +1409,11 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
             fill = st["window"]["verify_fill"]
         else:
             fill = st["items"] / st["batches"] if st["batches"] else 0.0
+        # Shed rate is target-level (admission counters sum across the
+        # target's groups); shown on every row of the target.
+        shed_rate = rate(
+            st["shed"], pv["shed"] if pv else 0.0, "admission_shed"
+        )
         identities = sorted(
             set(st["executed"]) | set(st["build"]) | set(st["view"])
         )
@@ -1320,8 +1447,8 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
                 flags.append(f"vc={int(vc)}")
             view = int(st["view"].get(ident, 0))
             lines.append(
-                f"{addr:<24}{rid:>3}{grp:>3}{rps:>9.1f}{fill:>7.1f}"
-                f"{min(util, 999.0):>7.1f}{st['depth']:>7.0f}"
+                f"{addr:<24}{rid:>3}{grp:>3}{rps:>9.1f}{shed_rate:>8.1f}"
+                f"{fill:>7.1f}{min(util, 999.0):>7.1f}{st['depth']:>7.0f}"
                 f"{st['peak']:>6.0f}{lag:>8.2f}{view:>5}"
                 f"  {' '.join(flags) or 'ok'}"
             )
@@ -1391,6 +1518,8 @@ def main(argv=None) -> int:
         return asyncio.run(_run_bench_clients(args))
     if args.command == "selftest":
         return asyncio.run(_run_selftest(args))
+    if args.command == "load":
+        return asyncio.run(_run_load(args))
     if args.command == "testnet":
         return _run_testnet_scaffold(args)
     return 2

@@ -118,6 +118,15 @@ class ReplicaMetrics:
         self.last_executed_mono = 0.0
         self.last_message_mono = 0.0
         self.current_view = 0
+        # Admission-control state (ISSUE 15): the bundle ingestor's rx
+        # queue depth/bound stamped per tick plus the high-water mark —
+        # the "is the replica's inbound path saturating" gauges that back
+        # the minbft_admission_* exposition and the BUSY retry-after
+        # scaling.  The companion counters (admission_shed /
+        # admission_busy_sent / admission_busy_suppressed) ride inc().
+        self.admission_rx_depth = 0
+        self.admission_rx_bound = 0
+        self.admission_rx_peak = 0
 
     def inc(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
@@ -147,6 +156,21 @@ class ReplicaMetrics:
     def observe_execute(self, seconds: float) -> None:
         self.execute_latency.observe(seconds)
         self.execute_hist.observe(seconds)
+
+    def note_admission_rx(self, depth: int, bound: int) -> None:
+        """Stamp the ingest rx queue's occupancy (called once per ingest
+        tick; the peak is the PR 9-style high-water mark the overload
+        acceptance test asserts bounded)."""
+        self.admission_rx_depth = depth
+        self.admission_rx_bound = bound
+        if depth > self.admission_rx_peak:
+            self.admission_rx_peak = depth
+
+    def admission_rx_saturation(self) -> float:
+        """Last-stamped rx fill fraction in [0, 1]."""
+        if self.admission_rx_bound <= 0:
+            return 0.0
+        return min(1.0, self.admission_rx_depth / self.admission_rx_bound)
 
     def observe_ingest(self, n_frames: int) -> None:
         """One bundle-ingest tick that decoded ``n_frames`` flat frames."""

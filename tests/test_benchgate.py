@@ -167,6 +167,61 @@ def test_committed_artifacts_pass_the_default_gate():
     assert benchgate_cli.main([]) == 0
 
 
+def test_load_goodput_is_gated_on_drop():
+    """ISSUE 15: load_*_goodput_per_sec joins the gate as a throughput
+    key — a collapse at the overload point regresses even when the
+    classic configs hold."""
+    base = _artifact(100.0, load_over_goodput_per_sec=400.0)
+    cand = _artifact(100.0, load_over_goodput_per_sec=100.0)  # -75%
+    report = benchgate.compare(base, cand)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["load_over_goodput"].status == "regression"
+    assert by_key["load_over_goodput"].direction == "drop"
+    # inside the 30% floor: noise, not regression
+    assert benchgate.compare(
+        base, _artifact(100.0, load_over_goodput_per_sec=300.0)
+    ).ok
+
+
+def test_load_p99_is_gated_on_increase():
+    """Latency gates the OTHER way: a p99 that climbs past the (wide)
+    latency floor regresses; a p99 that falls is an improvement, and a
+    2x climb sits inside the default 1.5x-increase floor."""
+    base = _artifact(100.0, load_sat_p99_ms=2000.0)
+    worse = _artifact(100.0, load_sat_p99_ms=9000.0)  # +350% > 150%
+    report = benchgate.compare(base, worse)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["load_sat_p99"].status == "regression"
+    assert by_key["load_sat_p99"].direction == "increase"
+    assert by_key["load_sat_p99"].drop == pytest.approx(7000.0)
+    assert benchgate.compare(
+        base, _artifact(100.0, load_sat_p99_ms=4000.0)  # 2x: tolerated
+    ).ok
+    better = benchgate.compare(
+        base, _artifact(100.0, load_sat_p99_ms=500.0)
+    )
+    assert {r.key: r.status for r in better.results}[
+        "load_sat_p99"
+    ] == "improved"
+    # the latency floor is independently tunable
+    assert not benchgate.compare(
+        base, _artifact(100.0, load_sat_p99_ms=4000.0), lat_rel_floor=0.5
+    ).ok
+
+
+def test_load_keys_do_not_leak_outside_their_namespace():
+    """Only the load_ namespace's _goodput_per_sec/_p99_ms keys join the
+    gate — e.g. an unrelated *_p99_ms diagnostic stays ungated."""
+    base = _artifact(
+        100.0, sched_p99_ms=5.0, other_goodput_per_sec=3.0
+    )
+    cand = _artifact(
+        100.0, sched_p99_ms=500.0, other_goodput_per_sec=0.1
+    )
+    report = benchgate.compare(base, cand)
+    assert [r.key for r in report.results] == ["e2e"]
+
+
 def test_groups_sweep_headline_is_gated():
     """The multi-group sweep's aggregate headline (ISSUE 10:
     groups{G}_req_per_sec_mean triples from bench_groups) participates

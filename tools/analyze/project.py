@@ -441,6 +441,12 @@ def default_config() -> AnalyzeConfig:
                     "minbft_tpu/client/client.py",
                     "client-side message (Client._handle_reply path)",
                 ),
+                # BUSY is client-bound like REPLY: replicas emit it at the
+                # admission boundary, only the client consumes it.
+                "Busy": (
+                    "minbft_tpu/client/client.py",
+                    "client-side admission signal (Client._handle_busy path)",
+                ),
             },
             # No authen exemptions needed: LogBase — the one unsigned kind —
             # carries neither a signature nor a ui field, so the structural

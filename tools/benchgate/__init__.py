@@ -11,7 +11,12 @@ Scope — deliberately narrow and honest:
   relative noise floor below absorbs), plus the
   ``*_util_effective_per_sec`` utilization headlines (ISSUE 14: the
   ledger's effective useful-lane rate — no stddev companion, so the
-  relative floor is the whole noise defense there).
+  relative floor is the whole noise defense there), plus the open-loop
+  curve headlines (ISSUE 15): ``load_*_goodput_per_sec`` gated on DROP
+  like a throughput mean, and ``load_*_p99_ms`` gated on INCREASE — a
+  latency key regresses when the candidate climbs past the allowance,
+  with its own (wider) relative floor because single-seed tail latency
+  swings far more than committed throughput does.
 - A key regresses when its drop exceeds BOTH noise defenses:
   ``drop > max(sigmas * sqrt(base_std² + cand_std²),
   rel_floor * base_mean)`` — the stddev band covers measured run-to-run
@@ -39,12 +44,24 @@ from typing import Dict, List, Tuple
 
 DEFAULT_SIGMAS = 3.0
 DEFAULT_REL_FLOOR = 0.30
+# Tail latency tolerance: p99 on the 1-core bench host legitimately
+# doubles run-to-run (retransmit-ladder alignment, GC pauses), so the
+# latency floor is deliberately wide — it catches order-of-magnitude
+# wedges, not jitter.
+DEFAULT_LAT_REL_FLOOR = 1.5
 
 _MEAN_SUFFIX = "_req_per_sec_mean"
 _STD_SUFFIX = "_req_per_sec_stddev"
 # Utilization headline (ISSUE 14): gated like a mean triple whose stddev
 # is 0.0 everywhere — the rel_floor absorbs single-window noise.
 _UTIL_SUFFIX = "_util_effective_per_sec"
+# Open-loop curve headlines (ISSUE 15).  Goodput gates on drop like any
+# throughput key; p99 gates on INCREASE (lower is better).  Both are
+# restricted to the ``load_`` namespace so unrelated future keys ending
+# in ``_per_sec`` / ``_ms`` don't silently join the gate.
+_LOAD_PREFIX = "load_"
+_LOAD_GOODPUT_SUFFIX = "_goodput_per_sec"
+_LOAD_P99_SUFFIX = "_p99_ms"
 
 
 class BackendMismatch(Exception):
@@ -57,9 +74,11 @@ class KeyResult:
     key: str  # the config prefix (e.g. "e2e", "mptcp")
     baseline: float
     candidate: float
-    drop: float  # baseline - candidate (positive = slower)
+    drop: float  # signed regression amount (positive = worse): baseline
+    # - candidate for throughput keys, candidate - baseline for latency
     allowed: float  # the noise allowance the drop is judged against
     status: str  # "ok" | "regression" | "improved"
+    direction: str = "drop"  # "drop" (lower cand = worse) | "increase"
 
 
 @dataclasses.dataclass
@@ -93,12 +112,15 @@ def backend_kind(artifact: dict) -> str:
 
 def gated_pairs(
     baseline: dict, candidate: dict
-) -> Tuple[Dict[str, str], List[str]]:
-    """``{prefix: mean_key}`` for every triple present in both
-    artifacts, plus the prefixes the candidate dropped."""
-    pairs: Dict[str, str] = {}
+) -> Tuple[Dict[str, Tuple[str, str]], List[str]]:
+    """``{prefix: (key, direction)}`` for every gated key present in
+    both artifacts, plus the prefixes the candidate dropped.
+    ``direction`` is ``"drop"`` (regression = candidate fell) or
+    ``"increase"`` (regression = candidate climbed; latency keys)."""
+    pairs: Dict[str, Tuple[str, str]] = {}
     missing: List[str] = []
     for key in sorted(baseline):
+        direction = "drop"
         if key.endswith(_MEAN_SUFFIX):
             prefix = key[: -len(_MEAN_SUFFIX)]
         elif key.endswith(_UTIL_SUFFIX):
@@ -106,10 +128,19 @@ def gated_pairs(
             # compare() then misses by construction and reads 0.0 —
             # exactly the single-run semantics the rel_floor covers
             prefix = key[: -len(_UTIL_SUFFIX)] + "_util"
+        elif key.startswith(_LOAD_PREFIX) and key.endswith(
+            _LOAD_GOODPUT_SUFFIX
+        ):
+            prefix = key[: -len("_per_sec")]
+        elif key.startswith(_LOAD_PREFIX) and key.endswith(
+            _LOAD_P99_SUFFIX
+        ):
+            prefix = key[: -len("_ms")]
+            direction = "increase"
         else:
             continue
         if key in candidate:
-            pairs[prefix] = key
+            pairs[prefix] = (key, direction)
         else:
             missing.append(prefix)
     return pairs, missing
@@ -120,6 +151,7 @@ def compare(
     candidate: dict,
     sigmas: float = DEFAULT_SIGMAS,
     rel_floor: float = DEFAULT_REL_FLOOR,
+    lat_rel_floor: float = DEFAULT_LAT_REL_FLOOR,
 ) -> GateReport:
     """Gate ``candidate`` against ``baseline``.  Raises
     :class:`BackendMismatch` before reading a single number when the
@@ -133,15 +165,20 @@ def compare(
         )
     pairs, missing = gated_pairs(baseline, candidate)
     results: List[KeyResult] = []
-    for prefix, mean_key in pairs.items():
+    for prefix, (mean_key, direction) in pairs.items():
         base_mean = float(baseline[mean_key])
         cand_mean = float(candidate[mean_key])
         base_std = float(baseline.get(prefix + _STD_SUFFIX, 0.0))
         cand_std = float(candidate.get(prefix + _STD_SUFFIX, 0.0))
-        drop = base_mean - cand_mean
+        if direction == "increase":
+            drop = cand_mean - base_mean
+            floor = lat_rel_floor
+        else:
+            drop = base_mean - cand_mean
+            floor = rel_floor
         allowed = max(
             sigmas * math.sqrt(base_std**2 + cand_std**2),
-            rel_floor * base_mean,
+            floor * base_mean,
         )
         if drop > allowed:
             status = "regression"
@@ -157,6 +194,7 @@ def compare(
                 drop=drop,
                 allowed=allowed,
                 status=status,
+                direction=direction,
             )
         )
     return GateReport(results=results, missing=missing, backend_kind=ck)

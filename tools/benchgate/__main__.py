@@ -19,6 +19,7 @@ import os
 import sys
 
 from . import (
+    DEFAULT_LAT_REL_FLOOR,
     DEFAULT_REL_FLOOR,
     DEFAULT_SIGMAS,
     BackendMismatch,
@@ -61,6 +62,14 @@ def main(argv=None) -> int:
         "documented swing)",
     )
     p.add_argument(
+        "--lat-rel-floor",
+        type=float,
+        default=DEFAULT_LAT_REL_FLOOR,
+        help="relative INCREASE always tolerated on latency keys "
+        "(load_*_p99_ms); wide by design (default 1.5) — single-seed "
+        "tail latency swings far more than throughput",
+    )
+    p.add_argument(
         "--fail-on-missing",
         action="store_true",
         help="treat a gated key present in the baseline but absent from "
@@ -82,15 +91,20 @@ def main(argv=None) -> int:
         return 2
     try:
         report = compare(
-            baseline, candidate, sigmas=args.sigmas, rel_floor=args.rel_floor
+            baseline,
+            candidate,
+            sigmas=args.sigmas,
+            rel_floor=args.rel_floor,
+            lat_rel_floor=args.lat_rel_floor,
         )
     except BackendMismatch as e:
         print(f"benchgate: REFUSED: {e}", file=sys.stderr)
         return 2
     if not report.results and not report.missing:
         print(
-            "benchgate: no *_req_per_sec_mean triples shared by the two "
-            "artifacts — nothing to gate",
+            "benchgate: no gated keys (*_req_per_sec_mean, "
+            "*_util_effective_per_sec, load_* curve headlines) shared by "
+            "the two artifacts — nothing to gate",
             file=sys.stderr,
         )
         return 2
@@ -112,9 +126,11 @@ def main(argv=None) -> int:
         for r in report.results:
             arrow = {"regression": "REGRESSION", "improved": "improved",
                      "ok": "ok"}[r.status]
+            unit = "ms   " if r.direction == "increase" else "req/s"
+            verb = "rise" if r.direction == "increase" else "drop"
             print(
                 f"  {r.key:12s} {r.baseline:10.1f} -> {r.candidate:10.1f} "
-                f"req/s  drop {r.drop:+.1f} vs allowed {r.allowed:.1f}  "
+                f"{unit}  {verb} {r.drop:+.1f} vs allowed {r.allowed:.1f}  "
                 f"[{arrow}]"
             )
         for prefix in report.missing:
