@@ -4,13 +4,16 @@
 #   make native      build the native C++ USIG module (+ its C++ unit test)
 #   make lint        three-layer lint tier: (1) compileall byte-compiles
 #                    every source file (syntax/undefined-name rot, zero
-#                    deps); (2) `python -m tools.analyze` runs the
-#                    project-aware invariant passes — lock discipline,
-#                    JAX trace purity, message-kind exhaustiveness, secret
-#                    hygiene, dead code (tools/analyze/README.md; the
-#                    `go test -race` + golangci-lint analogue of the
-#                    reference); (3) ruff (preferred, [tool.ruff] in
-#                    pyproject.toml) or pyflakes when installed
+#                    deps); (2) `python -m tools.analyze` runs the nine
+#                    project-aware invariant passes in parallel — lock
+#                    discipline, JAX trace purity, message-kind
+#                    exhaustiveness, secret hygiene, dead code, async
+#                    hygiene, task lifecycle, schema drift, env registry
+#                    (tools/analyze/README.md; the `go test -race` +
+#                    golangci-lint analogue of the reference) and prints
+#                    its wall time + slowest pass; (3) ruff (preferred,
+#                    [tool.ruff] in pyproject.toml) or pyflakes when
+#                    installed
 #   make fast        native + lint + the unit tier of the test suite (<2min)
 #   make check       native + lint + gate + the FULL test suite (~9min,
 #                    what CI runs)
@@ -61,10 +64,11 @@ chaos:
 	    -m pytest tests/test_chaos.py -q
 
 # compileall is the always-available floor; tools/analyze hard-fails on
-# any non-baselined finding of its five passes; ruff/pyflakes layer on
-# when present.  The presence check is separate from the run so a real
-# linter FAILURE fails the target (an `a && b || c` chain would swallow
-# it).
+# any non-baselined finding of its nine passes (run on a thread pool —
+# the summary line reports wall time and the slowest pass);
+# ruff/pyflakes layer on when present.  The presence check is separate
+# from the run so a real linter FAILURE fails the target (an
+# `a && b || c` chain would swallow it).
 lint:
 	$(PY) -m compileall -q minbft_tpu tests bench.py __graft_entry__.py
 	$(PY) -m tools.analyze

@@ -97,6 +97,28 @@ bench_extras line carries the headline-grade subset):
       {committed, verify_items, verify_fill, queue_depth:
       {start_index, values}}} — the SHAPE of the run the scalar means
       flatten (BENCH_extras.json only; the printed line stays compact)
+  ecdsa_sign_big_per_sec / ecdsa_sign_big_batch   the comb sign kernel
+      at the full bench batch (its amortized best operating point; only
+      emitted when batch >= 8192 — 2048 stays for comparability)
+  ro_reads / ro_clients / ro_reads_per_sec / ro_fast_replies
+      read-only fast path (bench_readonly): reads served straight from
+      replica-local state per second, with the fast-reply census
+  load_seed / load_clients / load_requests_per_point   open-loop load
+      harness operating point (bench_load; perf/LOAD_CURVES.md)
+  load_burst_peak_per_sec / load_peak_per_sec   sustained commit
+      capacity: the burst probe's estimate, then the peak re-anchored
+      by the measured saturation point
+  load_probe_offered_per_sec / _goodput_per_sec / _census_ok /
+  load_probe_shed / _busy_sent / _busy_received / _timeouts / _rx_peak
+      saturation probe: offered vs committed rate plus the admission
+      ledger (shed/BUSY counters; rx_peak is the ingest high-water mark)
+  load_{half,sat,over}_offered_per_sec / _goodput_per_sec / _p50_ms /
+  load_{half,sat,over}_p99_ms / _send_p99_ms / _timeouts / _census_ok /
+  load_{half,sat,over}_shed / _busy_sent / _busy_received / _rx_peak
+      the latency-vs-offered-load curve at 0.5x / 1x / 1.5x of peak —
+      benchgate gates the goodput (drop) and p99 (rise) headlines
+  load_over_goodput_fraction   goodput retained at 1.5x overload (the
+      admission-control graceful-degradation claim, as a fraction)
   uvloop   True when MINBFT_UVLOOP (auto-detect) put uvloop behind the
       bench's event loops — numbers are never silently attributed to
       the wrong loop
@@ -106,7 +128,7 @@ bench_extras line carries the headline-grade subset):
       per-item scalar oracle on the same host (bench_prep)
   tpu_unavailable, last_tpu   CPU-fallback honesty block: set whenever
       the backend is CPU, with the newest committed real-TPU round's
-      numbers carried forward (see _last_tpu_numbers)
+      numbers carried forward (the last-tpu carry helper)
   compile_cache_dir, compile_cache_entries_{before,after}   persistent
       compile cache keyed to the kernel tree (utils/jaxcache.py): a warm
       second run shows near-zero new entries and ~0 *_compile_s
@@ -1276,6 +1298,7 @@ async def _bench_cluster(
             # the wait/service ratio splits the critpath's verify and
             # reply_sign spans into queue_wait vs device/host service.
             for i, e in enumerate({id(e): e for e in engines}.values()):
+                # noqa: AH102 - one-shot artifact dump at bench teardown
                 with open(f"{base}.engine{i}.json", "w") as fh:
                     json.dump(obs_critpath.engine_queue_doc(e, ident=i), fh)
             docs = obs_trace.load_dumps(base)
@@ -1989,6 +2012,7 @@ def _last_tpu_numbers() -> "dict | None":
     )
     for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")), reverse=True):
         try:
+            # noqa: AH102 - one-shot read of committed artifacts at report time
             with open(path) as fh:
                 rec = json.load(fh)
         except (OSError, ValueError):

@@ -84,10 +84,16 @@ class _PrepareBatcher:
     batch.  Ship-when-idle: a lone request flushes on the next loop turn,
     so low-load latency is unchanged."""
 
-    def __init__(self, replica_id: int, handle_generated, max_batch: int = 64):
+    def __init__(
+        self, replica_id: int, handle_generated, spawn, max_batch: int = 64
+    ):
         self.replica_id = replica_id
         self.max_batch = max(1, max_batch)
         self._handle_generated = handle_generated
+        # Task factory honoring the _bg_tasks retention contract
+        # (Handlers._spawn_bg): a flush task nobody holds is GC-able
+        # mid-PREPARE and its failure vanishes.
+        self._spawn = spawn
         self._buffers: Dict[int, list] = {}  # view -> pending requests
         self._suspended = 0
 
@@ -134,7 +140,7 @@ class _PrepareBatcher:
         )
         # UI assignment order = task creation order (handle_generated's UI
         # lock wakes waiters FIFO), so batches hit the log in flush order.
-        asyncio.get_running_loop().create_task(self._handle_generated(prepare))
+        self._spawn(self._handle_generated(prepare))
 
 
 class Handlers:
@@ -314,9 +320,7 @@ class Handlers:
                 self.log.warning(
                     "request timeout for client %d seq %d", req.client_id, req.seq
                 )
-                asyncio.get_running_loop().create_task(
-                    self.handle_request_timeout(view)
-                )
+                self._spawn_bg(self.handle_request_timeout(view))
 
             self.client_states.client(req.client_id).start_request_timer(
                 req.seq, timeout, on_expiry
@@ -561,6 +565,7 @@ class Handlers:
         self._prepare_batcher = _PrepareBatcher(
             replica_id,
             self.handle_generated,
+            self._spawn_bg,
             max_batch=getattr(configer, "batchsize_prepare", 64),
         )
 
@@ -1342,6 +1347,16 @@ class Handlers:
         await self._maybe_apply_pending_new_view()
         return True
 
+    def _spawn_bg(self, coro) -> "asyncio.Task":
+        """``create_task`` under the ``_bg_tasks`` retention contract
+        (TL601): the loop holds only a weak reference to running tasks,
+        so the set keeps the strong one and the done-callback routes any
+        failure to the replica log instead of the unretrieved void."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._on_bg_task_done)
+        return task
+
     def _on_bg_task_done(self, task) -> None:
         """Done-callback for fire-and-forget background tasks: drop the
         strong reference and surface any failure in the replica log (the
@@ -1352,7 +1367,7 @@ class Handlers:
             return
         exc = task.exception()
         if exc is not None:
-            self.log.error("background new-view apply failed: %r", exc)
+            self.log.error("background task failed: %r", exc)
 
     async def _maybe_apply_pending_new_view(self) -> None:
         """Retry a NEW-VIEW that was deferred behind a state transfer.
@@ -1417,7 +1432,7 @@ class Handlers:
                     self.metrics.inc("timeouts_viewchange")
                     await self.request_view_change(new_view + 1)
 
-            asyncio.get_running_loop().create_task(escalate())
+            self._spawn_bg(escalate())
 
         # Re-arm only forward: demand quorums can complete out of order,
         # and a late lower-view transition must not silence the timer
@@ -2260,7 +2275,14 @@ class ClientStreamHandler(api.MessageStreamHandler):
                 if fin:
                     break
         finally:
+            # Cancel-and-await: a consume() failure (not just
+            # cancellation) re-raises here instead of rotting as an
+            # unretrieved task exception.
             consumer_task.cancel()
+            try:
+                await consumer_task
+            except asyncio.CancelledError:
+                pass
 
 
 async def _anext(ait: AsyncIterator[bytes]) -> Optional[bytes]:

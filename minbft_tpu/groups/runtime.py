@@ -501,9 +501,16 @@ class _GroupedPeerStreamHandler(api.MessageStreamHandler):
                 data, _ = drain_multi(fr, out)
                 yield data
         finally:
+            # Cancel-and-await: a demux() failure (not just cancellation)
+            # re-raises here instead of rotting as an unretrieved task
+            # exception.
             demux_task.cancel()
             for t in gtasks.values():
                 t.cancel()
+            try:
+                await demux_task
+            except asyncio.CancelledError:
+                pass
 
 
 class _GroupBundleIngestor(_BundleIngestor):
@@ -728,6 +735,10 @@ class _GroupedClientStreamHandler(api.MessageStreamHandler):
                     break
         finally:
             consumer_task.cancel()
+            try:
+                await consumer_task
+            except asyncio.CancelledError:
+                pass
 
 
 # ---------------------------------------------------------------------------

@@ -411,6 +411,10 @@ class OpenLoopGenerator:
                 await asyncio.sleep(0.05)
         finally:
             sweeper.cancel()
+            try:
+                await sweeper
+            except asyncio.CancelledError:
+                pass
             for slot in self._slots:
                 for t in slot.tasks:
                     t.cancel()

@@ -44,6 +44,7 @@ def git_rev() -> str:
     rev = os.environ.get("MINBFT_GIT_REV")
     if not rev:
         try:
+            # noqa: AH101 - one-shot and cached (5s cap); attribution only
             rev = subprocess.run(
                 ["git", "rev-parse", "--short", "HEAD"],
                 cwd=os.path.dirname(os.path.abspath(__file__)),

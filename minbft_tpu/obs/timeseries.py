@@ -431,6 +431,7 @@ def dump_timeseries(ts: TimeSeries, base: str,
     if extra:
         doc.update(extra)
     path = f"{base}.ts.json"
+    # noqa: AH102 - one-shot shutdown dump; no executor dependency at teardown
     with open(path, "w") as fh:
         json.dump(doc, fh)
     return path

@@ -354,6 +354,7 @@ def dump_recorder(rec: FlightRecorder, base: Optional[str] = None,
     doc.setdefault("build", runinfo.build_info())
     if extra:
         doc.update(extra)
+    # noqa: AH102 - one-shot crash/shutdown dump; forensics cannot rely on executors
     with open(path, "w") as fh:
         json.dump(doc, fh)
     return path
@@ -366,6 +367,7 @@ def load_dumps(base: str) -> List[dict]:
     docs = []
     for path in sorted(glob.glob(base + ".*.json")):
         try:
+            # noqa: AH102 - one-shot ingestion at bench report time
             with open(path) as fh:
                 docs.append(json.load(fh))
         except (OSError, ValueError):

@@ -222,6 +222,16 @@ class _DispatchQueue:
         self._device_ever_succeeded = False
         self._written_off_at = 0.0
         self._probing = False
+        # Strong refs to in-flight _run/_probe tasks: the loop keeps
+        # only a weak reference to a running task, so without this set a
+        # dispatch task is GC-able mid-flight (the TL601 contract).
+        self._bg_tasks: set = set()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
 
     # -- subclass hooks -----------------------------------------------------
 
@@ -334,7 +344,7 @@ class _DispatchQueue:
             self.inflight += 1
             # The reason rides with the batch and is counted in _run's
             # success accounting alongside ``batches``.
-            asyncio.get_running_loop().create_task(self._run(batch, reason))
+            self._spawn(self._run(batch, reason))
 
     # -- dispatch with the liveness net -------------------------------------
 
@@ -382,7 +392,7 @@ class _DispatchQueue:
             due = time.monotonic() - self._written_off_at >= self._REPROBE_AFTER
             if due and not self._probing:
                 self._probing = True
-                asyncio.get_running_loop().create_task(self._probe(list(items)))
+                self._spawn(self._probe(list(items)))
             return await asyncio.to_thread(fallback, items), True
         if not self._device_ever_succeeded:
             # Cold compile may be inside this dispatch — see

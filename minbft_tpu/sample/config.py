@@ -93,6 +93,7 @@ def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfig
     with ``CONSENSUS_*`` env overrides layered on top (see module doc)."""
     if env is None:
         env = os.environ
+    # noqa: AH102 - one small config file read once at replica startup
     with open(path) as fh:
         text = fh.read()
     data = _parse_yaml(text)

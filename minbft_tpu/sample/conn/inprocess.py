@@ -13,6 +13,11 @@ from typing import AsyncIterator, Dict, Optional
 
 from ... import api
 
+# Strong refs to scheduled aclose() tasks (TL601): the loop keeps only
+# a weak reference to a running task, so without this set a deferred
+# close is GC-able before the inner generator finalizes.
+_close_tasks: set = set()
+
 
 class ReplicaStub(api.ConnectionHandler):
     """Late-binding connection handler (reference
@@ -99,7 +104,9 @@ class _DeferredHandler(api.MessageStreamHandler):
                 except BaseException:
                     pass
 
-            asyncio.get_running_loop().create_task(_close())
+            t = asyncio.get_running_loop().create_task(_close())
+            _close_tasks.add(t)
+            t.add_done_callback(_close_tasks.discard)
 
 
 class InProcessPeerConnector(api.ReplicaConnector):

@@ -141,7 +141,13 @@ class _TcpStreamHandler(api.MessageStreamHandler):
                     return
                 yield frame
         finally:
+            # Cancel-and-await so a pump_out() failure surfaces here
+            # instead of rotting as an unretrieved task exception.
             pump.cancel()
+            try:
+                await pump
+            except asyncio.CancelledError:
+                pass
             await _close_writer(writer)
 
 

@@ -656,6 +656,7 @@ async def _run_replica(args) -> int:
         events = engine.drain_obs_events()
         if events:
             doc["events"] = [list(e) for e in events]
+        # noqa: AH102 - one-shot crash/shutdown dump; forensics cannot rely on executors
         with open(f"{base}.engine{args.id}.json", "w") as fh:
             _json.dump(doc, fh)
 
@@ -730,7 +731,13 @@ async def _run_replica(args) -> int:
             pass  # original fatal error
         raise
     if metrics_task is not None:
+        # Cancel-and-await: a log_metrics() failure surfaces here
+        # instead of rotting as an unretrieved task exception.
         metrics_task.cancel()
+        try:
+            await metrics_task
+        except asyncio.CancelledError:
+            pass
     await stop_sampler()
     print(f"replica {args.id} shutting down", file=sys.stderr)
     if metrics_server is not None:

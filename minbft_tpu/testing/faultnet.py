@@ -37,6 +37,11 @@ from .. import api
 
 CHAOS_SEED_ENV = "MINBFT_CHAOS_SEED"
 
+# Strong refs to scheduled aclose() tasks (TL601): the loop keeps only
+# a weak reference to a running task, so without this set a deferred
+# close is GC-able before the inner generator finalizes.
+_close_tasks: set = set()
+
 # The seeded (schedule-driven) fault kinds, in the order their draws are
 # consumed per frame — replay_counts depends on this order staying fixed.
 SEEDED_KINDS = ("drop", "delay", "duplicate", "reorder", "corrupt", "reset")
@@ -447,7 +452,9 @@ class FaultNet:
                     pass
 
             if hasattr(ait, "aclose"):
-                asyncio.get_running_loop().create_task(_close())
+                t = asyncio.get_running_loop().create_task(_close())
+                _close_tasks.add(t)
+                t.add_done_callback(_close_tasks.discard)
 
     # -- replay --------------------------------------------------------
 

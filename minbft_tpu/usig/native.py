@@ -45,6 +45,7 @@ _load_attempted = False
 def build(quiet: bool = True) -> bool:
     """Build the native module in-tree (requires g++).  True on success."""
     try:
+        # noqa: AH101 - one-shot native build at first load (gated by _load_attempted)
         res = subprocess.run(
             ["make", "libusig.so"],
             cwd=os.path.abspath(_NATIVE_DIR),

@@ -75,6 +75,7 @@ PointA = Tuple[int, int]
 
 
 def _inv(x: int, m: int) -> int:
+    # noqa: AH104 - deliberate host-crypto fallback; the hot path batches off-loop
     return pow(x, -1, m)
 
 
@@ -348,6 +349,7 @@ def ed_scalar_mult(k: int, p: EdPoint) -> EdPoint:
 
 def ed_compress(p: EdPoint) -> bytes:
     x, y, z, _ = p
+    # noqa: AH104 - host-crypto fallback; keygen runs once at test-net setup
     zi = pow(z, -1, ED_P)
     x, y = x * zi % ED_P, y * zi % ED_P
     return (y | ((x & 1) << 255)).to_bytes(32, "little")

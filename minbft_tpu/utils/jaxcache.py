@@ -34,6 +34,7 @@ def tree_key() -> str:
                     continue
                 path = os.path.join(dirpath, name)
                 h.update(name.encode())
+                # noqa: AH102 - one-time startup hash of the kernel tree
                 with open(path, "rb") as fh:
                     h.update(fh.read())
     return h.hexdigest()[:16]

@@ -224,3 +224,211 @@ def test_cli_stale_baseline_fails_and_allow_stale_passes(tmp_path):
     assert res.returncode == 1 and "STALE" in res.stdout
     res = _run_cli(["--baseline", str(bl), "--allow-stale"], REPO)
     assert res.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# machine-readable output (the CI contract)
+
+
+def test_json_report_and_annotation_round_trip():
+    from tools.analyze.core import (
+        finding_to_dict,
+        findings_to_json,
+        github_annotation,
+    )
+
+    f = Finding(
+        "AH101", "src/app.py", 12,
+        "blocking call time.sleep() on the event loop, 50% slower",
+        severity="error", pass_name="async-hygiene",
+    )
+    w = Finding(
+        "DC402", "src/m.py", 3, "unused local x",
+        severity="warning", pass_name="dead-code",
+    )
+    doc = json.loads(findings_to_json([f, w], stale=[], passes=["async-hygiene", "dead-code"], timings={"dead-code": 0.51}))
+    assert doc["version"] == 1 and doc["ok"] is False
+    assert doc["passes"] == ["async-hygiene", "dead-code"]
+    assert doc["findings"][0] == finding_to_dict(f)
+    assert doc["findings"][0]["pass"] == "async-hygiene"
+    assert doc["findings"][0]["fingerprint"] == f.fingerprint
+    assert doc["timings_s"] == {"dead-code": 0.51}
+
+    # warnings alone keep ok true; stale entries flip it
+    assert json.loads(findings_to_json([w]))["ok"] is True
+    assert json.loads(findings_to_json([], stale=["DC401:x:y"]))["ok"] is False
+
+    ann = github_annotation(f)
+    assert ann.startswith("::error ")
+    assert "file=src/app.py" in ann and "line=12" in ann
+    # the % in the message must be escaped per the Actions grammar
+    assert "50%25 slower" in ann
+    assert github_annotation(w).startswith("::warning ")
+
+
+def test_cli_json_flags_and_annotations(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "tools/analyze/placeholder.txt": "",
+            "minbft_tpu/bad.py": "import os\n",
+        },
+    )
+    out_file = tmp_path / "report.json"
+    res = _run_cli(
+        ["--root", str(tmp_path), "--json", "--json-out", str(out_file),
+         "--github-annotations"],
+        REPO,
+    )
+    assert res.returncode == 1
+    doc = json.loads(res.stdout[: res.stdout.index("\n::")] if "\n::" in res.stdout else res.stdout)
+    assert doc["ok"] is False
+    assert any(f["code"] == "DC401" for f in doc["findings"])
+    on_disk = json.loads(out_file.read_text())
+    assert on_disk["findings"] == doc["findings"]
+    assert any(
+        line.startswith(("::error", "::warning"))
+        for line in res.stdout.splitlines()
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-pass baselines
+
+
+def test_baseline_set_partitions_and_detects_stale(tmp_path):
+    from tools.analyze.core import BaselineSet
+
+    bs = BaselineSet(tmp_path / "baselines")
+    dc = Finding("DC401", "src/m.py", 1, "unused import os",
+                 pass_name="dead-code")
+    ah = Finding("AH101", "src/a.py", 2, "blocking call",
+                 pass_name="async-hygiene")
+    n = bs.write([dc, ah], ran=["dead-code", "async-hygiene"])
+    assert n == 2
+    assert (tmp_path / "baselines" / "dead-code.json").exists()
+    assert (tmp_path / "baselines" / "async-hygiene.json").exists()
+
+    bs = BaselineSet(tmp_path / "baselines")
+    reported, suppressed, stale = bs.apply(
+        [dc, ah], ran=["dead-code", "async-hygiene"]
+    )
+    assert reported == [] and len(suppressed) == 2 and stale == []
+
+    # fix the AH finding -> only ITS per-pass file reports stale
+    reported, suppressed, stale = bs.apply([dc], ran=["dead-code", "async-hygiene"])
+    assert reported == [] and len(suppressed) == 1
+    assert len(stale) == 1 and "AH101" in stale[0]
+
+    # a pass that did not run must NOT stale its baseline
+    reported, suppressed, stale = bs.apply([dc], ran=["dead-code"])
+    assert stale == []
+
+
+def test_baseline_set_orphan_files(tmp_path):
+    from tools.analyze.core import BaselineSet
+
+    d = tmp_path / "baselines"
+    d.mkdir()
+    (d / "dead-code.json").write_text('{"version": 1, "findings": {}}')
+    (d / "retired-pass.json").write_text('{"version": 1, "findings": {}}')
+    bs = BaselineSet(d)
+    assert bs.orphan_files(["dead-code", "async-hygiene"]) == [
+        "retired-pass.json"
+    ]
+
+
+def test_cli_stale_per_pass_baseline_fails(tmp_path):
+    write_tree(
+        tmp_path,
+        {
+            "tools/analyze/placeholder.txt": "",
+            "minbft_tpu/ok.py": "",
+        },
+    )
+    d = tmp_path / "bl"
+    d.mkdir()
+    (d / "dead-code.json").write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "findings": {
+                    "DC401:minbft_tpu/gone.py:unused import os": {
+                        "count": 1,
+                        "justification": "was grandfathered",
+                    }
+                },
+            }
+        )
+    )
+    res = _run_cli(
+        ["--root", str(tmp_path), "--baseline-dir", str(d),
+         "--select", "dead-code"],
+        REPO,
+    )
+    assert res.returncode == 1 and "STALE" in res.stdout
+    res = _run_cli(
+        ["--root", str(tmp_path), "--baseline-dir", str(d),
+         "--select", "dead-code", "--allow-stale"],
+        REPO,
+    )
+    assert res.returncode == 0
+
+    # a pass that is not selected must not stale its per-pass file
+    res = _run_cli(
+        ["--root", str(tmp_path), "--baseline-dir", str(d),
+         "--select", "task-lifecycle"],
+        REPO,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# pass inventory, selftest liveness
+
+
+ALL_PASS_NAMES = (
+    "lock-discipline",
+    "trace-purity",
+    "exhaustiveness",
+    "secret-hygiene",
+    "dead-code",
+    "async-hygiene",
+    "task-lifecycle",
+    "schema-drift",
+    "env-registry",
+)
+
+
+def test_all_nine_passes_registered():
+    passes = all_passes()
+    prefixes = {cls.code_prefix for cls in passes.values()}
+    assert {"LD", "TP", "EX", "SH", "DC", "AH", "TL", "SD", "ER"} <= prefixes
+    for name in ALL_PASS_NAMES:
+        assert name in passes
+
+
+def test_cli_list_documents_scope_for_every_pass():
+    out = _run_cli(["--list"], REPO)
+    assert out.returncode == 0
+    for name in ALL_PASS_NAMES:
+        assert name in out.stdout
+    # every registered pass prints a scope line
+    assert out.stdout.count("scope:") == len(ALL_PASS_NAMES)
+
+
+def test_cli_selftest_every_pass_flags_its_fixture():
+    out = _run_cli(["--selftest"], REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    for name in ALL_PASS_NAMES:
+        assert f"selftest: {name} OK" in out.stdout
+
+
+def test_repo_baselines_are_empty():
+    """The acceptance pin: the committed per-pass baselines carry ZERO
+    grandfathered findings — real findings were fixed, not suppressed."""
+    d = REPO / "tools" / "analyze" / "baselines"
+    files = sorted(d.glob("*.json"))
+    assert len(files) == len(ALL_PASS_NAMES)
+    for p in files:
+        assert json.loads(p.read_text())["findings"] == {}

@@ -648,3 +648,585 @@ def test_dead_code_respects_reexports_and_annotations(tmp_path):
         ["dead-code"],
     )
     assert findings == []
+
+# ---------------------------------------------------------------------------
+# async hygiene
+
+
+def ah_config(**kw):
+    from tools.analyze.project import AsyncHygieneConfig
+
+    return make_config(async_hygiene=AsyncHygieneConfig(roots=("src",), **kw))
+
+
+def test_async_hygiene_flags_blocking_sink_through_sync_helper(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import time
+
+            def helper():
+                time.sleep(0.5)
+
+            async def handler():
+                helper()
+            """,
+        },
+        ah_config(),
+        ["async-hygiene"],
+    )
+    assert codes(findings) == ["AH101"]
+    assert "handler" in findings[0].message
+
+
+def test_async_hygiene_cross_module_chain_and_witness_path(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/util.py": """
+            import subprocess
+
+            def probe():
+                subprocess.run(["true"])
+            """,
+            "src/app.py": """
+            from src.util import probe
+
+            def shim():
+                probe()
+
+            async def serve():
+                shim()
+            """,
+        },
+        ah_config(),
+        ["async-hygiene"],
+    )
+    assert codes(findings) == ["AH101"]
+    assert "serve" in findings[0].message and "probe" in findings[0].message
+
+
+def test_async_hygiene_executor_handoff_is_whitelisted(tmp_path):
+    # The SAME blocking helper is fine when it only runs behind
+    # asyncio.to_thread / run_in_executor: the hand-off suspends.
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import asyncio
+            import time
+
+            def helper():
+                time.sleep(0.5)
+
+            async def handler():
+                await asyncio.to_thread(helper)
+
+            async def handler2():
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, helper)
+            """,
+        },
+        ah_config(),
+        ["async-hygiene"],
+    )
+    assert findings == []
+
+
+def test_async_hygiene_boundary_config_excludes_function(tmp_path):
+    files = {
+        "src/app.py": """
+        import time
+
+        def engine_step():
+            time.sleep(0.001)
+
+        async def run():
+            engine_step()
+        """,
+    }
+    flagged = analyze(tmp_path / "a", files, ah_config(), ["async-hygiene"])
+    assert codes(flagged) == ["AH101"]
+    excused = analyze(
+        tmp_path / "b",
+        files,
+        ah_config(
+            boundary={"src/app.py::engine_step": "micro-bounded by design"}
+        ),
+        ["async-hygiene"],
+    )
+    assert excused == []
+
+
+def test_async_hygiene_sync_io_and_lock_and_pow(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def dump(self, path, doc):
+                    with open(path, "w") as fh:
+                        fh.write(doc)
+
+                async def bump(self):
+                    with self._lock:
+                        pass
+
+            async def modexp(x):
+                return pow(x, 65537, 2**255 - 19)
+            """,
+        },
+        ah_config(),
+        ["async-hygiene"],
+    )
+    assert sorted(codes(findings)) == ["AH102", "AH103", "AH104"]
+
+
+def test_async_hygiene_sync_context_not_flagged(tmp_path):
+    # The same sinks OUTSIDE the loop-reachable graph are fine.
+    findings = analyze(
+        tmp_path,
+        {
+            "src/tool.py": """
+            import time
+
+            def main():
+                time.sleep(1)
+                with open("x") as fh:
+                    return fh.read()
+            """,
+        },
+        ah_config(),
+        ["async-hygiene"],
+    )
+    assert findings == []
+
+
+def test_async_hygiene_loop_scheduled_reference_is_a_root(tmp_path):
+    # A SYNC function handed to call_soon runs on the loop: its sinks count.
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import asyncio
+            import time
+
+            def tick():
+                time.sleep(0.1)
+
+            def arm(loop):
+                loop.call_soon(tick)
+            """,
+        },
+        ah_config(),
+        ["async-hygiene"],
+    )
+    assert codes(findings) == ["AH101"]
+
+
+def test_async_hygiene_on_this_repo_is_clean():
+    from tools.analyze.project import default_config
+
+    project = Project(REPO, config=default_config())
+    assert run_passes(project, select=["async-hygiene"]) == []
+
+
+# ---------------------------------------------------------------------------
+# task lifecycle
+
+
+def tl_config():
+    from tools.analyze.project import TaskLifecycleConfig
+
+    return make_config(tasks=TaskLifecycleConfig(roots=("src",)))
+
+
+def test_task_lifecycle_flags_dropped_and_unretained_tasks(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import asyncio
+
+            async def work():
+                pass
+
+            async def bare():
+                asyncio.create_task(work())
+
+            async def named_but_dropped():
+                t = asyncio.create_task(work())
+                print("unrelated", 1)
+
+            async def conditional_dropped(flag):
+                t = (asyncio.create_task(work()) if flag else None)
+            """,
+        },
+        tl_config(),
+        ["task-lifecycle"],
+    )
+    assert codes(findings) == ["TL601", "TL601", "TL601"]
+
+
+def test_task_lifecycle_retention_evidence_not_flagged(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import asyncio
+
+            async def work():
+                pass
+
+            class H:
+                def __init__(self):
+                    self._bg_tasks = set()
+
+                def spawn(self):
+                    t = asyncio.get_running_loop().create_task(work())
+                    self._bg_tasks.add(t)
+                    t.add_done_callback(self._bg_tasks.discard)
+                    return t
+
+                def attr_store(self):
+                    self._task = asyncio.create_task(work())
+
+            async def awaited():
+                await asyncio.create_task(work())
+
+            async def cancelled_then_awaited():
+                t = asyncio.create_task(work())
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+
+            async def callback_only():
+                t = asyncio.create_task(work())
+                t.add_done_callback(lambda _t: None)
+
+            async def gathered():
+                t = asyncio.create_task(work())
+                await asyncio.gather(t)
+            """,
+        },
+        tl_config(),
+        ["task-lifecycle"],
+    )
+    assert findings == []
+
+
+def test_task_lifecycle_flags_unsnapshotted_tracked_set_iteration(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import asyncio
+
+            class H:
+                def __init__(self):
+                    self._bg_tasks = set()
+
+                def spawn(self, coro):
+                    t = asyncio.create_task(coro)
+                    self._bg_tasks.add(t)
+                    t.add_done_callback(self._bg_tasks.discard)
+                    return t
+
+                def cancel_all(self):
+                    for t in self._bg_tasks:
+                        t.cancel()
+            """,
+        },
+        tl_config(),
+        ["task-lifecycle"],
+    )
+    assert codes(findings) == ["TL602"]
+    assert "list(" in findings[0].message
+
+
+def test_task_lifecycle_snapshotted_iteration_not_flagged(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": """
+            import asyncio
+
+            class H:
+                def __init__(self):
+                    self._bg_tasks = set()
+
+                def spawn(self, coro):
+                    t = asyncio.create_task(coro)
+                    self._bg_tasks.add(t)
+                    t.add_done_callback(self._bg_tasks.discard)
+                    return t
+
+                def cancel_all(self):
+                    for t in list(self._bg_tasks):
+                        t.cancel()
+            """,
+        },
+        tl_config(),
+        ["task-lifecycle"],
+    )
+    assert findings == []
+
+
+def test_task_lifecycle_on_this_repo_is_clean():
+    from tools.analyze.project import default_config
+
+    project = Project(REPO, config=default_config())
+    assert run_passes(project, select=["task-lifecycle"]) == []
+
+
+# ---------------------------------------------------------------------------
+# schema drift
+
+
+def sd_files(bench_doc, bench_body, gate_body, prom_body="", test_body=""):
+    return {
+        "bench.py": f'"""{bench_doc}"""\n{bench_body}',
+        "gate/__init__.py": gate_body,
+        "obs/prom.py": prom_body,
+        "tests/test_pins.py": test_body,
+    }
+
+
+def sd_config(**kw):
+    from tools.analyze.project import SchemaDriftConfig
+
+    return make_config(
+        schema=SchemaDriftConfig(
+            bench_module="bench.py",
+            benchgate_module="gate/__init__.py",
+            prom_module="obs/prom.py",
+            pinned_tests=("tests/test_pins.py",),
+            **kw,
+        )
+    )
+
+
+SD_DOC = """Bench.
+
+Extras schema:
+  cfg_req_per_sec_mean   committed throughput
+  ro_reads_per_sec       read-only phase rate
+
+Environment knobs:
+  NONE
+"""
+
+
+def test_schema_drift_clean_when_aligned(tmp_path):
+    findings = analyze(
+        tmp_path,
+        sd_files(
+            SD_DOC,
+            'out = {"cfg_req_per_sec_mean": 1.0, "ro_reads_per_sec": 2.0}\n',
+            '_MEAN_SUFFIX = "_req_per_sec_mean"\n',
+        ),
+        sd_config(),
+        ["schema-drift"],
+    )
+    assert findings == []
+
+
+def test_schema_drift_flags_each_direction(tmp_path):
+    findings = analyze(
+        tmp_path,
+        sd_files(
+            SD_DOC.replace(
+                "\nEnvironment knobs:",
+                "  ghost_req_per_sec_mean   never emitted\n"
+                "\nEnvironment knobs:",
+            ),
+            'out = {"cfg_req_per_sec_mean": 1.0,'
+            ' "new_goodput_per_sec": 3.0}\n',
+            '_MEAN_SUFFIX = "_req_per_sec_meanX"\n',
+        ),
+        sd_config(),
+        ["schema-drift"],
+    )
+    got = sorted(codes(findings))
+    # cfg_req_per_sec_mean headline but ungated (701); the suffix gate
+    # matches nothing (702); ghost_* documented but dead (703);
+    # new_goodput_per_sec emitted+headline-suffixed but ungated AND
+    # undocumented (701, 704); ro_reads_per_sec doc'd but dead (703).
+    assert got == ["SD701", "SD701", "SD702", "SD703", "SD703", "SD704"]
+
+
+def test_schema_drift_exempt_families_skip_gating(tmp_path):
+    findings = analyze(
+        tmp_path,
+        sd_files(
+            SD_DOC,
+            'out = {"cfg_req_per_sec_mean": 1.0, "ro_reads_per_sec": 2.0,'
+            ' "probe_goodput_per_sec": 3.0}\n',
+            '_MEAN_SUFFIX = "_req_per_sec_mean"\n',
+        ),
+        sd_config(
+            exempt={"probe_goodput_per_sec": "diagnostic, not a headline"}
+        ),
+        ["schema-drift"],
+    )
+    assert findings == []
+
+
+def test_schema_drift_pinned_prom_names(tmp_path):
+    findings = analyze(
+        tmp_path,
+        sd_files(
+            SD_DOC,
+            'out = {"cfg_req_per_sec_mean": 1.0, "ro_reads_per_sec": 2.0}\n',
+            '_MEAN_SUFFIX = "_req_per_sec_mean"\n',
+            prom_body='FAM = "minbft_committed_total"\n',
+            test_body=(
+                'OK = "minbft_committed_total"\n'
+                'BAD = "minbft_never_registered_total"\n'
+            ),
+        ),
+        sd_config(),
+        ["schema-drift"],
+    )
+    assert codes(findings) == ["SD705"]
+    assert "minbft_never_registered_total" in findings[0].message
+
+
+def test_schema_drift_fstring_families_intersect(tmp_path):
+    # f-string keys become * families on BOTH sides of the cross-check.
+    findings = analyze(
+        tmp_path,
+        sd_files(
+            SD_DOC + "  load_{half,sat,over}_p99_ms   sweep latency\n",
+            "out = {\"cfg_req_per_sec_mean\": 1.0,"
+            " \"ro_reads_per_sec\": 2.0}\n"
+            "for point in ('half', 'sat', 'over'):\n"
+            "    out[f'load_{point}_p99_ms'] = 1.0\n",
+            '_MEAN_SUFFIX = "_req_per_sec_mean"\n',
+        ),
+        sd_config(),
+        ["schema-drift"],
+    )
+    assert findings == []
+
+
+def test_schema_drift_on_this_repo_is_clean():
+    from tools.analyze.project import default_config
+
+    project = Project(REPO, config=default_config())
+    assert run_passes(project, select=["schema-drift"]) == []
+
+
+# ---------------------------------------------------------------------------
+# env registry
+
+
+def er_config():
+    from tools.analyze.project import EnvRegistryConfig
+
+    return make_config(
+        env=EnvRegistryConfig(roots=("src",), registry="ENV.md")
+    )
+
+
+ER_HEADER = "# Registry\n\n| Variable | Description |\n|---|---|\n"
+
+
+def test_env_registry_clean_when_registered(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": 'import os\nV = os.environ.get("MINBFT_KNOB")\n',
+            "ENV.md": ER_HEADER + "| `MINBFT_KNOB` | turns the knob |\n",
+        },
+        er_config(),
+        ["env-registry"],
+    )
+    assert findings == []
+
+
+def test_env_registry_flags_unregistered_dead_and_undescribed(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": (
+                "import os\n"
+                'A = os.environ.get("MINBFT_LIVE")\n'
+                'B = os.environ.get("MINBFT_NEW_KNOB")\n'
+            ),
+            "ENV.md": ER_HEADER
+            + "| `MINBFT_LIVE` | TODO: describe |\n"
+            + "| `MINBFT_GONE` | removed long ago |\n",
+        },
+        er_config(),
+        ["env-registry"],
+    )
+    got = sorted(codes(findings))
+    assert got == ["ER501", "ER502", "ER503"]
+
+
+def test_env_registry_prefix_pattern_covers_fstring_sites(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {
+            "src/app.py": (
+                "import os\n"
+                "def get(i):\n"
+                '    return os.environ.get(f"MINBFT_CFG{i}_REQUESTS")\n'
+            ),
+            "ENV.md": ER_HEADER + "| `MINBFT_CFG*` | per-config knobs |\n",
+        },
+        er_config(),
+        ["env-registry"],
+    )
+    assert findings == []
+
+
+def test_env_registry_missing_registry_is_one_finding(tmp_path):
+    findings = analyze(
+        tmp_path,
+        {"src/app.py": 'import os\nV = os.environ.get("MINBFT_KNOB")\n'},
+        er_config(),
+        ["env-registry"],
+    )
+    assert codes(findings) == ["ER501"]
+    assert "registry missing" in findings[0].message
+
+
+def test_env_registry_write_then_clean(tmp_path):
+    from tools.analyze.passes.env_registry import write_registry
+
+    files = {
+        "src/app.py": 'import os\nV = os.environ.get("MINBFT_KNOB")\n',
+    }
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    project = Project(tmp_path, config=er_config())
+    relpath, count = write_registry(project)
+    assert count == 1
+    # freshly generated: every description is a TODO -> ER503 only
+    project = Project(tmp_path, config=er_config())
+    findings = run_passes(project, select=["env-registry"])
+    assert codes(findings) == ["ER503"]
+    # describe it -> clean
+    reg = tmp_path / relpath
+    reg.write_text(reg.read_text().replace("TODO: describe", "the knob"))
+    project = Project(tmp_path, config=er_config())
+    assert run_passes(project, select=["env-registry"]) == []
+
+
+def test_env_registry_on_this_repo_is_clean():
+    from tools.analyze.project import default_config
+
+    project = Project(REPO, config=default_config())
+    assert run_passes(project, select=["env-registry"]) == []

@@ -1,27 +1,86 @@
 """CLI for the static-analysis suite.
 
-    python -m tools.analyze                   # all passes, baseline applied
-    python -m tools.analyze --list-passes
-    python -m tools.analyze --select lock-discipline,secret-hygiene
+    python -m tools.analyze                   # all passes, baselines applied
+    python -m tools.analyze --list            # every pass, with its scope
+    python -m tools.analyze --select async-hygiene,task-lifecycle
     python -m tools.analyze --write-baseline  # grandfather current findings
     python -m tools.analyze --no-baseline     # full picture, nothing hidden
+    python -m tools.analyze --json            # machine-readable output (CI)
+    python -m tools.analyze --github-annotations  # ::error inline on the PR
+    python -m tools.analyze --selftest        # per-pass liveness fixtures
+    python -m tools.analyze --write-env-registry  # regenerate ENV_VARS.md
 
-Exit codes: 0 clean · 1 findings (or stale baseline entries) · 2 internal
-error / bad usage.  ``make lint`` runs this after compileall.
+Passes run in PARALLEL on a thread pool (--serial to disable) and the
+total wall time is printed — `make lint` budgets on it.  Baselines are
+per-pass files under tools/analyze/baselines/<pass>.json; the legacy
+single-file mode survives behind an explicit --baseline PATH.
+
+Exit codes: 0 clean · 1 error-severity findings (or stale baseline
+entries) · 2 internal error / bad usage.  ``make lint`` runs this after
+compileall.
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import tempfile
+import time
 from pathlib import Path
 
-from .core import AnalysisError, Baseline, Project, all_passes, run_passes
+from .core import (
+    AnalysisError,
+    Baseline,
+    BaselineSet,
+    Project,
+    all_passes,
+    findings_to_json,
+    github_annotation,
+    run_passes,
+)
 
 
 def _default_root() -> Path:
     # tools/analyze/__main__.py -> repo root is two levels up from tools/.
     return Path(__file__).resolve().parent.parent.parent
+
+
+def _selftest(out) -> int:
+    """Run every registered pass against its own known-bad fixture.
+
+    The CI liveness step: each pass writes its fixture tree into a temp
+    dir and MUST produce at least one finding there — a pass that has
+    been unregistered, broken, or configured into silence fails loudly
+    here even though the real repo is clean.  Output is one line per
+    pass so CI can additionally pin the expected pass set by grep.
+    """
+    failures = 0
+    for name, cls in sorted(all_passes().items()):
+        try:
+            files, config = cls.selftest()
+            with tempfile.TemporaryDirectory(prefix="analyze-selftest-") as d:
+                root = Path(d)
+                for rel, content in files.items():
+                    p = root / rel
+                    p.parent.mkdir(parents=True, exist_ok=True)
+                    p.write_text(content, encoding="utf-8")
+                found = run_passes(
+                    Project(root, config=config), select=[name], parallel=False
+                )
+        except Exception as e:  # a crashing fixture is as dead as a silent one
+            print(f"selftest: {name} FAILED ({e})", file=out)
+            failures += 1
+            continue
+        if found:
+            print(f"selftest: {name} OK ({len(found)} finding(s))", file=out)
+        else:
+            print(
+                f"selftest: {name} FAILED (known-bad fixture produced no "
+                "findings — the pass is dead)",
+                file=out,
+            )
+            failures += 1
+    return 1 if failures else 0
 
 
 def main(argv=None) -> int:
@@ -43,7 +102,15 @@ def main(argv=None) -> int:
         "--baseline",
         type=Path,
         default=None,
-        help="baseline file (default: tools/analyze/baseline.json under root)",
+        help="LEGACY single baseline file applied across all passes "
+        "(default: the per-pass directory below)",
+    )
+    ap.add_argument(
+        "--baseline-dir",
+        type=Path,
+        default=None,
+        help="per-pass baseline directory "
+        "(default: tools/analyze/baselines under root)",
     )
     ap.add_argument(
         "--no-baseline",
@@ -53,7 +120,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--write-baseline",
         action="store_true",
-        help="grandfather all current findings into the baseline file",
+        help="grandfather current findings into the per-pass baseline "
+        "files (with --select: only the selected passes' files)",
     )
     ap.add_argument(
         "--allow-stale",
@@ -61,71 +129,168 @@ def main(argv=None) -> int:
         help="do not fail on baseline entries that no longer match "
         "(transition aid; the default treats them as errors)",
     )
-    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="print the machine-readable JSON report instead of the table",
+    )
+    ap.add_argument(
+        "--json-out",
+        type=Path,
+        default=None,
+        help="also write the JSON report to a file (CI artifact)",
+    )
+    ap.add_argument(
+        "--github-annotations",
+        action="store_true",
+        help="emit ::error/::warning workflow commands per finding "
+        "(GitHub shows them inline on the PR diff)",
+    )
+    ap.add_argument(
+        "--serial",
+        action="store_true",
+        help="run passes serially instead of on the thread pool",
+    )
+    ap.add_argument(
+        "--selftest",
+        action="store_true",
+        help="prove liveness: every pass must flag its own known-bad "
+        "fixture (the CI injection step)",
+    )
+    ap.add_argument(
+        "--write-env-registry",
+        action="store_true",
+        help="regenerate tools/analyze/ENV_VARS.md from the live "
+        "MINBFT_*/CONSENSUS_* getenv sites (preserves descriptions)",
+    )
+    ap.add_argument(
+        "--list", "--list-passes", dest="list_passes", action="store_true",
+        help="document every pass: prefix, name, severity, and scope",
+    )
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
 
     try:
         if args.list_passes:
             for name, cls in sorted(all_passes().items()):
-                print(f"{cls.code_prefix:4} {name:18} {cls.description}")
+                print(
+                    f"{cls.code_prefix:4} {name:18} [{cls.severity}] "
+                    f"{cls.description}"
+                )
+                if cls.scope:
+                    print(f"{'':4} {'':18} scope: {cls.scope}")
             return 0
 
-        project = Project(args.root)
-        select = args.select.split(",") if args.select else None
-        findings = run_passes(project, select=select)
+        if args.selftest:
+            return _selftest(sys.stdout)
 
-        baseline_path = args.baseline or (
-            project.root / "tools" / "analyze" / "baseline.json"
+        project = Project(args.root)
+
+        if args.write_env_registry:
+            from .passes.env_registry import write_registry
+
+            path, n = write_registry(project)
+            print(f"env-registry: wrote {n} entries to {path}")
+            return 0
+
+        select = args.select.split(",") if args.select else None
+        timings: dict = {}
+        t0 = time.perf_counter()
+        findings = run_passes(
+            project, select=select, parallel=not args.serial, timings=timings
+        )
+        wall = time.perf_counter() - t0
+        ran = select or sorted(all_passes())
+
+        baseline_set = BaselineSet(
+            args.baseline_dir
+            or (project.root / "tools" / "analyze" / "baselines")
         )
 
         if args.write_baseline:
-            if select:
-                # A partial run sees only the selected passes' findings;
-                # writing it out would destroy every other pass's entries
-                # (and their justifications).
-                raise AnalysisError(
-                    "--write-baseline requires a full run; drop --select"
+            if args.baseline is not None:
+                # Legacy single-file write: full runs only — a partial
+                # run would destroy the other passes' entries.
+                if select:
+                    raise AnalysisError(
+                        "--write-baseline with a legacy single --baseline "
+                        "file requires a full run; drop --select (per-pass "
+                        "baseline files handle partial writes)"
+                    )
+                old = Baseline.load(args.baseline)
+                Baseline.from_findings(findings, old=old).save(args.baseline)
+                print(
+                    f"baseline: wrote {len(findings)} finding(s) to "
+                    f"{args.baseline}"
                 )
-            old = Baseline.load(baseline_path)
-            Baseline.from_findings(findings, old=old).save(baseline_path)
-            todo = sum(
-                1
-                for e in Baseline.load(baseline_path).entries.values()
-                if e.get("justification", "").startswith("TODO")
-            )
+                return 0
+            todo = baseline_set.write(findings, ran)
             print(
-                f"baseline: wrote {len(findings)} finding(s) to "
-                f"{baseline_path}"
+                f"baseline: wrote {len(findings)} finding(s) across "
+                f"{len(ran)} per-pass file(s) under {baseline_set.directory}"
                 + (f" ({todo} entries need a justification)" if todo else "")
             )
             return 0
 
         if args.no_baseline:
-            reported, stale = findings, []
+            reported, suppressed, stale = findings, [], []
+        elif args.baseline is not None:
+            reported, suppressed, stale = Baseline.load(args.baseline).apply(
+                findings
+            )
         else:
-            baseline = Baseline.load(baseline_path)
-            reported, suppressed, stale = baseline.apply(findings)
-            if suppressed and not args.quiet:
-                print(
-                    f"baseline: {len(suppressed)} grandfathered finding(s) "
-                    f"suppressed"
-                )
+            reported, suppressed, stale = baseline_set.apply(findings, ran)
+            # Baseline files for unregistered passes rot silently unless
+            # a full run checks for them.
+            if not select:
+                stale = list(stale) + [
+                    f"(orphan baseline file) {name}"
+                    for name in baseline_set.orphan_files(all_passes())
+                ]
+        if suppressed and not args.quiet and not args.json:
+            print(
+                f"baseline: {len(suppressed)} grandfathered finding(s) "
+                f"suppressed"
+            )
 
-        for f in reported:
-            print(f.render())
+        errors = [f for f in reported if f.severity == "error"]
         rc = 0
-        if reported:
-            print(f"{len(reported)} finding(s)")
+        if errors:
             rc = 1
-        if stale:
+        if stale and not args.allow_stale:
+            rc = 1
+
+        json_doc = findings_to_json(reported, stale, ran, timings)
+        if args.json_out is not None:
+            args.json_out.write_text(json_doc, encoding="utf-8")
+        if args.json:
+            sys.stdout.write(json_doc)
+        else:
+            for f in reported:
+                print(f.render())
+            if reported:
+                print(
+                    f"{len(reported)} finding(s) "
+                    f"({len(errors)} error(s), "
+                    f"{len(reported) - len(errors)} warning(s))"
+                )
             for fp in stale:
                 print(f"STALE baseline entry (fixed? remove it): {fp}")
-            if not args.allow_stale:
-                rc = 1
-        if rc == 0 and not args.quiet:
-            names = select or sorted(all_passes())
-            print(f"analyze: clean ({', '.join(names)})")
+            if rc == 0 and not args.quiet:
+                slowest = max(timings, key=timings.get) if timings else ""
+                detail = (
+                    f", slowest {slowest} {timings[slowest]:.2f}s"
+                    if slowest
+                    else ""
+                )
+                mode = "serial" if args.serial else "parallel"
+                print(
+                    f"analyze: clean ({', '.join(ran)}) in {wall:.2f}s "
+                    f"wall [{mode}{detail}]"
+                )
+        if args.github_annotations:
+            for f in reported:
+                print(github_annotation(f))
         return rc
     except AnalysisError as e:
         print(f"analyze: error: {e}", file=sys.stderr)

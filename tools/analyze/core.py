@@ -22,19 +22,26 @@ exhaustiveness, secret hygiene) instead of generic style.  The pieces:
 from __future__ import annotations
 
 import ast
+import concurrent.futures
 import dataclasses
 import json
 import re
+import threading
+import time
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
     "AnalysisError",
     "Baseline",
+    "BaselineSet",
     "Finding",
     "Pass",
     "Project",
     "all_passes",
+    "finding_to_dict",
+    "findings_to_json",
+    "github_annotation",
     "register_pass",
     "run_passes",
 ]
@@ -52,19 +59,86 @@ class Finding:
     survive unrelated edits shifting code up or down.  Two identical
     findings in one file (same code + message) share a fingerprint; the
     baseline stores a count so fixing one of them is still detected.
+
+    ``severity`` is ``"error"`` (fails the run) or ``"warning"``
+    (reported, never fails); it defaults from the emitting pass.
+    ``pass_name`` is stamped by :func:`run_passes` so per-pass baselines
+    and the JSON output can attribute every finding without re-deriving
+    the owner from the code prefix.
     """
 
     code: str  # e.g. "LD001"
     path: str  # repo-relative posix path
     line: int  # 1-based
     message: str
+    severity: str = "error"
+    pass_name: str = ""
 
     @property
     def fingerprint(self) -> str:
         return f"{self.code}:{self.path}:{self.message}"
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}: {self.code} {self.message}"
+        tag = "" if self.severity == "error" else f" [{self.severity}]"
+        return f"{self.path}:{self.line}: {self.code}{tag} {self.message}"
+
+
+def finding_to_dict(f: Finding) -> dict:
+    """The machine-readable shape of one finding (stable key order)."""
+    return {
+        "code": f.code,
+        "path": f.path,
+        "line": f.line,
+        "message": f.message,
+        "severity": f.severity,
+        "pass": f.pass_name,
+        "fingerprint": f.fingerprint,
+    }
+
+
+def findings_to_json(
+    findings: Sequence[Finding],
+    stale: Optional[Sequence[str]] = None,
+    passes: Optional[Sequence[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
+) -> str:
+    """The CI contract: one JSON document with every reported finding,
+    the stale baseline fingerprints, which passes ran, and their wall
+    times — the GitHub-annotations emitter and any future dashboards
+    consume THIS, never the human table."""
+    doc = {
+        "version": 1,
+        "passes": sorted(passes or []),
+        "findings": [finding_to_dict(f) for f in findings],
+        "stale": sorted(stale or []),
+        "timings_s": {k: round(v, 4) for k, v in sorted((timings or {}).items())},
+        "ok": not [f for f in findings if f.severity == "error"]
+        and not (stale or []),
+    }
+    return json.dumps(doc, indent=2) + "\n"
+
+
+def github_annotation(f: Finding) -> str:
+    """One GitHub Actions workflow command per finding
+    (``::error file=…,line=…,title=…::message``) — the annotation shows
+    up inline on the PR diff.  Newlines/commas in properties are escaped
+    per the Actions command grammar."""
+    level = "error" if f.severity == "error" else "warning"
+
+    def prop(s: str) -> str:
+        return (
+            s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+            .replace(":", "%3A").replace(",", "%2C")
+        )
+
+    def data(s: str) -> str:
+        return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+    return (
+        f"::{level} file={prop(f.path)},line={f.line},"
+        f"title={prop(f.code + ' (' + (f.pass_name or 'analyze') + ')')}"
+        f"::{data(f.message)}"
+    )
 
 
 class Project:
@@ -87,6 +161,11 @@ class Project:
         self.config = config
         self._asts: Dict[str, ast.Module] = {}
         self._sources: Dict[str, str] = {}
+        # Passes run concurrently (run_passes parallel mode) and share
+        # this cache; the lock makes the fill race-free rather than
+        # merely benign (two threads parsing the same module wastes the
+        # slower one's work).
+        self._cache_lock = threading.Lock()
 
     # -- file access --------------------------------------------------------
 
@@ -97,26 +176,31 @@ class Project:
         return (self.root / relpath).is_file()
 
     def source(self, relpath: str) -> str:
-        src = self._sources.get(relpath)
-        if src is None:
-            try:
-                src = (self.root / relpath).read_text(encoding="utf-8")
-            except OSError as e:
-                raise AnalysisError(f"cannot read {relpath}: {e}") from e
-            self._sources[relpath] = src
-        return src
+        with self._cache_lock:
+            src = self._sources.get(relpath)
+            if src is None:
+                try:
+                    src = (self.root / relpath).read_text(encoding="utf-8")
+                except OSError as e:
+                    raise AnalysisError(f"cannot read {relpath}: {e}") from e
+                self._sources[relpath] = src
+            return src
 
     def tree(self, relpath: str) -> ast.Module:
-        tree = self._asts.get(relpath)
-        if tree is None:
-            try:
-                tree = ast.parse(self.source(relpath), filename=relpath)
-            except SyntaxError as e:
-                # compileall owns syntax errors; surface as analyzer error
-                # rather than crashing with a traceback.
-                raise AnalysisError(f"syntax error in {relpath}: {e}") from e
-            self._asts[relpath] = tree
-        return tree
+        src = self.source(relpath)
+        with self._cache_lock:
+            tree = self._asts.get(relpath)
+            if tree is None:
+                try:
+                    tree = ast.parse(src, filename=relpath)
+                except SyntaxError as e:
+                    # compileall owns syntax errors; surface as analyzer
+                    # error rather than crashing with a traceback.
+                    raise AnalysisError(
+                        f"syntax error in {relpath}: {e}"
+                    ) from e
+                self._asts[relpath] = tree
+            return tree
 
     def python_files(self, under: Optional[Sequence[str]] = None) -> List[str]:
         """Repo-relative paths of tracked .py files under the given
@@ -263,23 +347,114 @@ class Baseline:
         return reported, suppressed, stale
 
 
+class BaselineSet:
+    """Per-pass baselines: ``<dir>/<pass-name>.json``, one
+    :class:`Baseline` file per pass.
+
+    The per-pass split keeps partial runs safe (``--select`` touches only
+    the selected passes' files) and keeps ownership obvious — a finding's
+    grandfather entry lives in the file named after the pass that emits
+    it.  Staleness covers the FILES too: a baseline file whose stem names
+    no registered pass is itself stale (the pass was renamed or removed;
+    the file must go with it).
+    """
+
+    def __init__(self, directory: Path):
+        self.directory = Path(directory)
+
+    def path_for(self, pass_name: str) -> Path:
+        return self.directory / f"{pass_name}.json"
+
+    def known_files(self) -> List[Path]:
+        if not self.directory.is_dir():
+            return []
+        return sorted(self.directory.glob("*.json"))
+
+    def orphan_files(self, registered: Iterable[str]) -> List[str]:
+        """Baseline files naming no registered pass (rename/removal rot)."""
+        names = set(registered)
+        return [
+            p.name for p in self.known_files() if p.stem not in names
+        ]
+
+    def apply(
+        self, findings: Sequence[Finding], ran: Sequence[str]
+    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """-> (reported, suppressed, stale) across the passes that ran.
+
+        Only the files of passes in ``ran`` participate: a ``--select``
+        run cannot judge staleness of baselines whose findings it never
+        computed.  Stale fingerprints are prefixed ``<pass>:`` so the
+        owning file is obvious in the report."""
+        by_pass: Dict[str, List[Finding]] = {name: [] for name in ran}
+        for f in findings:
+            by_pass.setdefault(f.pass_name, []).append(f)
+        reported: List[Finding] = []
+        suppressed: List[Finding] = []
+        stale: List[str] = []
+        for name in ran:
+            bl = Baseline.load(self.path_for(name))
+            rep, sup, st = bl.apply(by_pass.get(name, []))
+            reported.extend(rep)
+            suppressed.extend(sup)
+            stale.extend(f"{name}:{fp}" for fp in st)
+        reported.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+        return reported, suppressed, sorted(stale)
+
+    def write(self, findings: Sequence[Finding], ran: Sequence[str]) -> int:
+        """Regenerate the files of the passes that ran (preserving
+        surviving justifications); returns the number of entries that
+        still need a human justification."""
+        self.directory.mkdir(parents=True, exist_ok=True)
+        by_pass: Dict[str, List[Finding]] = {name: [] for name in ran}
+        for f in findings:
+            by_pass.setdefault(f.pass_name, []).append(f)
+        todo = 0
+        for name in ran:
+            path = self.path_for(name)
+            old = Baseline.load(path)
+            new = Baseline.from_findings(by_pass.get(name, []), old=old)
+            new.save(path)
+            todo += sum(
+                1
+                for e in new.entries.values()
+                if e.get("justification", "").startswith("TODO")
+            )
+        return todo
+
+
 # -- pass registry ----------------------------------------------------------
 
 
 class Pass:
     """One analysis plug-in.
 
-    Subclass, set ``code_prefix``/``name``/``description``, implement
-    :meth:`run`, and register the class with :func:`register_pass`.  A pass
-    emits raw findings; the framework applies noqa and the baseline.
+    Subclass, set ``code_prefix``/``name``/``description``/``scope``,
+    implement :meth:`run`, and register the class with
+    :func:`register_pass`.  A pass emits raw findings; the framework
+    applies noqa and the baseline, and stamps ``severity``/``pass_name``
+    on findings the pass left at the defaults.
+
+    :meth:`selftest` is the CI liveness contract: it returns a fixture
+    tree (relpath -> source) plus a config under which the pass MUST
+    produce at least one finding.  ``python -m tools.analyze --selftest``
+    runs every registered pass's fixture and fails if any pass stays
+    silent — a disabled or dead pass cannot hide behind a clean repo.
     """
 
     code_prefix: str = "XX"
     name: str = "unnamed"
     description: str = ""
+    scope: str = ""  # which files/invariants the pass covers (--list)
+    severity: str = "error"
 
     def run(self, project: Project) -> List[Finding]:  # pragma: no cover
         raise NotImplementedError
+
+    @classmethod
+    def selftest(cls) -> Tuple[Dict[str, str], object]:  # pragma: no cover
+        """(fixture files, config) on which :meth:`run` must flag."""
+        raise NotImplementedError(f"pass {cls.name!r} has no selftest fixture")
 
 
 _REGISTRY: Dict[str, type] = {}
@@ -299,25 +474,65 @@ def all_passes() -> Dict[str, type]:
     return dict(_REGISTRY)
 
 
+def _stamp(cls: type, findings: List[Finding]) -> List[Finding]:
+    """Fill in pass-level defaults the pass left unset: owner name, and
+    the pass's severity for findings still at the field default."""
+    out = []
+    for f in findings:
+        changes = {}
+        if not f.pass_name:
+            changes["pass_name"] = cls.name
+        if f.severity == "error" and cls.severity != "error":
+            changes["severity"] = cls.severity
+        out.append(dataclasses.replace(f, **changes) if changes else f)
+    return out
+
+
 def run_passes(
     project: Project,
     select: Optional[Sequence[str]] = None,
     progress: Optional[Callable[[str], None]] = None,
+    parallel: bool = True,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Run the (selected) passes; returns noqa-filtered findings sorted by
     location.  Baseline application is the caller's job (the CLI), so
-    library users see the full picture."""
+    library users see the full picture.
+
+    ``parallel`` runs the passes on a thread pool (they share the
+    Project's locked AST cache; each pass is read-only over it) — pass
+    wall times land in ``timings`` (name -> seconds) when given, so the
+    CLI can print where lint time goes.  Findings are gathered in pass
+    order regardless of completion order: output stays deterministic.
+    """
     passes = all_passes()
     names = list(select) if select else sorted(passes)
-    findings: List[Finding] = []
     for name in names:
         if name not in passes:
             raise AnalysisError(
                 f"unknown pass {name!r}; available: {', '.join(sorted(passes))}"
             )
+
+    def run_one(name: str) -> List[Finding]:
         if progress:
             progress(name)
-        findings.extend(passes[name]().run(project))
+        t0 = time.perf_counter()
+        result = _stamp(passes[name], passes[name]().run(project))
+        if timings is not None:
+            timings[name] = time.perf_counter() - t0
+        return result
+
+    findings: List[Finding] = []
+    if parallel and len(names) > 1:
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(len(names), 8), thread_name_prefix="analyze"
+        ) as pool:
+            futures = {name: pool.submit(run_one, name) for name in names}
+            for name in names:  # pass order, not completion order
+                findings.extend(futures[name].result())
+    else:
+        for name in names:
+            findings.extend(run_one(name))
     findings = [f for f in findings if not is_suppressed(project, f)]
     findings.sort(key=lambda f: (f.path, f.line, f.code, f.message))
     return findings

@@ -1,9 +1,13 @@
 """Analysis passes.  Importing this package registers every pass."""
 
 from . import (  # noqa  (imports ARE the registration side effect)
+    async_hygiene,
     dead_code,
+    env_registry,
     exhaustiveness,
     lock_discipline,
+    schema_drift,
     secret_hygiene,
+    task_lifecycle,
     trace_purity,
 )

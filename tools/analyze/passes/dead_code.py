@@ -65,6 +65,19 @@ class DeadCodePass(Pass):
     code_prefix = "DC"
     name = "dead-code"
     description = "unused imports and unused local variables"
+    scope = "all configured source roots (the pyflakes floor)"
+
+    @classmethod
+    def selftest(cls):
+        from ..project import AnalyzeConfig, DeadCodeConfig
+
+        files = {"app.py": "import os\n\ndef f():\n    x = 1\n    return 2\n"}
+        config = AnalyzeConfig(
+            source_roots=("app.py",), lock_classes=(), trace=None,
+            exhaustiveness=None, secrets=None,
+            dead=DeadCodeConfig(roots=("app.py",)),
+        )
+        return files, config
 
     def run(self, project: Project) -> List[Finding]:
         cfg = project.config.dead

@@ -66,6 +66,32 @@ class ExhaustivenessPass(Pass):
     code_prefix = "EX"
     name = "exhaustiveness"
     description = "message kinds wired through codec, authen and handlers"
+    scope = "messages/message.py vs codec.py, authen.py, message_handling.py"
+
+    @classmethod
+    def selftest(cls):
+        from ..project import AnalyzeConfig, ExhaustivenessConfig
+
+        files = {
+            "message.py": 'class Ping:\n    KIND = "ping"\n',
+            "codec.py": "",
+            "authen.py": "",
+            "handlers.py": (
+                "def validate_message(m):\n    pass\n"
+                "def process_message(m):\n    pass\n"
+            ),
+        }
+        config = AnalyzeConfig(
+            source_roots=("message.py",), lock_classes=(), trace=None,
+            exhaustiveness=ExhaustivenessConfig(
+                message_module="message.py",
+                codec_module="codec.py",
+                authen_module="authen.py",
+                handler_module="handlers.py",
+            ),
+            secrets=None, dead=None,
+        )
+        return files, config
 
     def run(self, project: Project) -> List[Finding]:
         cfg = project.config.exhaustiveness

@@ -141,6 +141,34 @@ class LockDisciplinePass(Pass):
     description = (
         "guarded state-class attributes written only under their lock"
     )
+    scope = "the LockClassSpec-configured state classes (core/internal, …)"
+
+    @classmethod
+    def selftest(cls):
+        from ..project import AnalyzeConfig, LockClassSpec
+
+        files = {
+            "app.py": (
+                "import threading\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._x = 0\n"
+                "    def bump(self):\n"
+                "        self._x += 1\n"
+            ),
+        }
+        config = AnalyzeConfig(
+            source_roots=("app.py",),
+            lock_classes=(
+                LockClassSpec(
+                    path="app.py", cls="C", locks=("_lock",),
+                    guarded=("_x",), mode="threads",
+                ),
+            ),
+            trace=None, exhaustiveness=None, secrets=None, dead=None,
+        )
+        return files, config
 
     def run(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []

@@ -108,6 +108,19 @@ class SecretHygienePass(Pass):
     code_prefix = "SH"
     name = "secret-hygiene"
     description = "no key material in f-strings, logs, print or repr"
+    scope = "minbft_tpu/ (keystore, hostcrypto, sealbox flows)"
+
+    @classmethod
+    def selftest(cls):
+        from ..project import AnalyzeConfig, SecretHygieneConfig
+
+        files = {"app.py": 'priv = b"k"\nmsg = f"key={priv}"\n'}
+        config = AnalyzeConfig(
+            source_roots=("app.py",), lock_classes=(), trace=None,
+            exhaustiveness=None, dead=None,
+            secrets=SecretHygieneConfig(roots=("app.py",)),
+        )
+        return files, config
 
     def run(self, project: Project) -> List[Finding]:
         cfg = project.config.secrets

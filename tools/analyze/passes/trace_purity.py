@@ -77,6 +77,27 @@ class TracePurityPass(Pass):
     code_prefix = "TP"
     name = "trace-purity"
     description = "no Python side effects reachable from jitted entry points"
+    scope = "ops/, parallel/, obs/ (every jit wrapper root)"
+
+    @classmethod
+    def selftest(cls):
+        from ..project import AnalyzeConfig, TracePurityConfig
+
+        files = {
+            "app.py": (
+                "import jax\n"
+                "def body(x):\n"
+                "    print(x)\n"
+                "    return x\n"
+                "f = jax.jit(body)\n"
+            ),
+        }
+        config = AnalyzeConfig(
+            source_roots=("app.py",), lock_classes=(),
+            trace=TracePurityConfig(roots=("app.py",)),
+            exhaustiveness=None, secrets=None, dead=None,
+        )
+        return files, config
 
     def run(self, project: Project) -> List[Finding]:
         cfg = project.config.trace

@@ -151,6 +151,143 @@ class DeadCodeConfig:
 
 
 # ---------------------------------------------------------------------------
+# async hygiene (AH)
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncHygieneConfig:
+    """Event-loop blocking-sink rules for the coroutine call graph.
+
+    The pass roots a cross-module call graph at every ``async def`` under
+    ``roots`` and follows *calls* (sync helpers run inline on the loop;
+    un-awaited coroutine calls still run on the loop via create_task).
+    Functions passed by REFERENCE to ``asyncio.to_thread`` /
+    ``run_in_executor`` never enter the graph — the hand-off itself is
+    the suspension-aware boundary, so blocking work behind it is free.
+
+    ``boundary`` lists additional ``"relpath::qualname"`` functions the
+    walk must not descend into (justified engine hand-off points whose
+    blocking is micro-bounded by design); each entry carries a reason.
+    """
+
+    roots: Tuple[str, ...] = ()
+    # Dotted call origins that block the loop outright (AH101).
+    blocking_calls: Tuple[str, ...] = (
+        "time.sleep",
+        "os.system",
+        "os.wait",
+        "os.waitpid",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "socket.create_connection",
+        "socket.getaddrinfo",
+        "urllib.request.urlopen",
+        "requests.get",
+        "requests.post",
+    )
+    # Sync file-IO sinks (AH102): the builtin plus Path-style methods.
+    io_calls: Tuple[str, ...] = ("open",)
+    io_methods: Tuple[str, ...] = (
+        "read_text",
+        "write_text",
+        "read_bytes",
+        "write_bytes",
+    )
+    # Attribute-call / with-statement lock heuristics (AH103): a sync
+    # ``.acquire()`` or ``with self._lock`` on the loop serializes the
+    # loop behind whatever thread holds the lock.
+    lock_attr_re: str = r"(^|_)(lock|cond|condition|sema|semaphore)s?$"
+    # (relpath::qualname, reason) — boundary functions the walk skips.
+    boundary: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# task lifecycle (TL)
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskLifecycleConfig:
+    """Rules for background-task retention (the ``_bg_tasks`` contract).
+
+    A task whose only reference is the scheduler's weak set can be
+    garbage-collected mid-flight and its exception silently dropped —
+    the exact bug fixed twice before this pass existed (PR 2, PR 6).
+    ``roots`` are the files/dirs scanned; ``factories`` the call names
+    that mint tasks.
+    """
+
+    roots: Tuple[str, ...] = ()
+    factories: Tuple[str, ...] = ("create_task", "ensure_future")
+    # Container-mutator names that count as retention when the task is
+    # their argument (self._bg_tasks.add(task), tasks.append(task), …).
+    retainers: Tuple[str, ...] = ("add", "append", "insert", "setdefault")
+
+
+# ---------------------------------------------------------------------------
+# schema drift (SD)
+
+
+@dataclasses.dataclass(frozen=True)
+class SchemaDriftConfig:
+    """The four key-schema sources the SD pass cross-checks.
+
+    Families are glob-ish patterns over key names (``*`` = any run of
+    characters, from f-string placeholders).  The checks:
+
+    - an EMITTED family whose suffix marks it headline-grade must match
+      a GATED pattern (emitted-but-ungated, SD701);
+    - every GATED pattern must intersect an emitted family
+      (gated-but-never-emitted, SD702);
+    - every family documented in the bench schema header must intersect
+      an emitted family (doc'd-but-dead, SD703);
+    - emitted rate families (``documented_suffixes``) must be covered by
+      the schema header (emitted-but-undocumented, SD704);
+    - ``minbft_*`` names pinned in tests must match a Prometheus family
+      registered by the prom module (pinned-but-unregistered, SD705).
+    """
+
+    bench_module: str = "bench.py"
+    benchgate_module: str = "tools/benchgate/__init__.py"
+    prom_module: str = "minbft_tpu/obs/prom.py"
+    # Test files whose string literals pin bench keys / prom names.
+    pinned_tests: Tuple[str, ...] = ()
+    # Suffixes that make an emitted family headline-grade (must be gated).
+    headline_suffixes: Tuple[str, ...] = (
+        "_req_per_sec_mean",
+        "_util_effective_per_sec",
+        "_goodput_per_sec",
+    )
+    # Suffixes whose emitted families must appear in the schema header.
+    documented_suffixes: Tuple[str, ...] = ("_per_sec",)
+    # Emitted families exempt from SD701/SD704 with a reason each
+    # (progress/diagnostic keys that are deliberately not gated).
+    exempt: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# env registry (ER)
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvRegistryConfig:
+    """Registry contract for environment knobs.
+
+    Every ``MINBFT_*``/``CONSENSUS_*`` string literal at a getenv site in
+    ``roots`` must appear in the committed registry markdown with a
+    one-line description; registry entries matching no live site are
+    dead.  F-string env names contribute prefix wildcards
+    (``MINBFT_BENCH_CFG*``) that keep their expansions alive.
+    """
+
+    roots: Tuple[str, ...] = ()
+    registry: str = "tools/analyze/ENV_VARS.md"
+    name_re: str = r"^(MINBFT|CONSENSUS)_[A-Z0-9_]+$"
+    prefix_re: str = r"^(MINBFT|CONSENSUS)_[A-Z0-9_]*$"
+
+
+# ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +298,12 @@ class AnalyzeConfig:
     exhaustiveness: Optional[ExhaustivenessConfig]
     secrets: SecretHygieneConfig
     dead: DeadCodeConfig
+    # v2 passes (ISSUE 16); None disables the pass, so fixture configs
+    # that predate it keep working unchanged.
+    async_hygiene: Optional[AsyncHygieneConfig] = None
+    tasks: Optional[TaskLifecycleConfig] = None
+    schema: Optional[SchemaDriftConfig] = None
+    env: Optional[EnvRegistryConfig] = None
 
 
 def default_config() -> AnalyzeConfig:
@@ -465,5 +608,31 @@ def default_config() -> AnalyzeConfig:
                 "bench.py",
                 "__graft_entry__.py",
             ),
+        ),
+        async_hygiene=AsyncHygieneConfig(
+            # Product code only: tests block freely (pytest-asyncio runs
+            # each loop for one test), and bench's sync warmup helpers
+            # run before the loop starts.
+            roots=("minbft_tpu", "bench.py"),
+            boundary={},  # filled below once real boundary sites are known
+        ),
+        tasks=TaskLifecycleConfig(
+            roots=("minbft_tpu", "bench.py"),
+        ),
+        schema=SchemaDriftConfig(
+            bench_module="bench.py",
+            benchgate_module="tools/benchgate/__init__.py",
+            prom_module="minbft_tpu/obs/prom.py",
+            # Tests that pin PRODUCT prom families by literal name.
+            # (test_metrics_endpoint.py pins only its own local fixture
+            # families, so it is deliberately absent.)
+            pinned_tests=(
+                "tests/test_obs.py",
+                "tests/test_chaos.py",
+                "tests/test_process_cluster.py",
+            ),
+        ),
+        env=EnvRegistryConfig(
+            roots=("minbft_tpu", "bench.py", "__graft_entry__.py"),
         ),
     )
