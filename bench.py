@@ -119,6 +119,33 @@ bench_extras line carries the headline-grade subset):
       benchgate gates the goodput (drop) and p99 (rise) headlines
   load_over_goodput_fraction   goodput retained at 1.5x overload (the
       admission-control graceful-degradation claim, as a fraction)
+  groups{G}x{C}_load_{sat,over}_offered_per_sec / _goodput_per_sec /
+  groups{G}x{C}_load_{sat,over}_p50_ms / _p99_ms / _census_ok / _shed /
+  groups{G}x{C}_load_{sat,over}_busy_sent
+      (G, chips) engine-pool grid (bench_groups_chips, ISSUE 17): G
+      groups round-robin over a C-chip EnginePool (one engine per home
+      chip), each grid point its own open-loop curve — a burst probe
+      (groups{G}x{C}_load_burst_peak_per_sec) anchors a SAT (1x) and
+      OVER (2x) point.  benchgate gates the goodput (drop) and p99
+      (rise) headlines exactly like the top-level load_* curve.
+  groups{G}x{C}_chips / groups{G}x{C}_placement /
+  groups{G}x{C}_verify_mean_batch /
+  groups{G}x{C}_chip{c}_util_busy / _util_fill /
+  groups{G}x{C}_chip{c}_util_lanes_{useful,padding,memo,fallback} /
+  groups{G}x{C}_stripe_util_lanes_useful / _util_batches /
+  groups{G}x{C}_util_*   (full ledger block, as {prefix}_util_* above)
+      the SAT point's pool attribution (PoolLedger, obs/ledger.py):
+      post-clamp chip count, group→home-chip placement, pool-wide MAC
+      host-lane fill, per-chip busy/fill + lane census, the striped
+      overflow engine's lane count, and the pool-AGGREGATE utilization
+      identity (ceiling scaled ×C, sources stamped "… xC") whose
+      _util_effective_per_sec benchgate gates.  C=1 reduces exactly to
+      the bare DeviceLedger block — the differential-tested identity.
+  groups_chips_grid_Gs / groups_chips_grid_chips /
+  groups_chips_requested_chips / groups_chips_devices_visible
+      grid meta: the swept axes post-clamp (chips clamps to visible
+      devices — C=1 only on the CPU container), what was asked for, and
+      how many devices the run saw
   uvloop   True when MINBFT_UVLOOP (auto-detect) put uvloop behind the
       bench's event loops — numbers are never silently attributed to
       the wrong loop
@@ -143,9 +170,15 @@ Environment knobs:
   MINBFT_BENCH_SLO_P50_MS   latency target for the *_at_p50_* runs (500)
   MINBFT_BENCH_SKIP_E2E / _SKIP_MP / _SKIP_NODEDUP / _SKIP_SLO /
   _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519 / _SKIP_RO /
-  _SKIP_INGEST / _SKIP_GROUPS   phase gates
+  _SKIP_INGEST / _SKIP_GROUPS / _SKIP_LOAD / _SKIP_GRID   phase gates
   MINBFT_BENCH_GROUPS_REQUESTS   per-group sweep load (400 with OpenSSL
                                  host crypto, 48 pure-Python containers)
+  MINBFT_BENCH_GRID_GS      (G, chips) grid group counts ("2,4,8" — G=1
+                            is the ungrouped load_* curve's subject)
+  MINBFT_BENCH_GRID_CHIPS   grid chip counts ("1,2,4,8"), clamped to
+                            visible devices
+  MINBFT_BENCH_GRID_REQUESTS / _CLIENTS   per-grid-point arrival budget
+                            (600) and identity fleet size (400)
   MINBFT_BENCH_GROUPS_RUNS       runs per sweep point (default 1)
   MINBFT_BENCH_INGEST_REQUESTS   ingest-sweep run length (400 CPU / 600)
   MINBFT_BUNDLE_INGEST=0         runtime lever: per-frame-task pumps
@@ -1988,6 +2021,112 @@ def bench_load() -> dict:
     return out
 
 
+def bench_groups_chips() -> dict:
+    """(G, chips) grid over the multi-device engine pool (ISSUE 17):
+    G consensus groups placed round-robin on a C-chip
+    :class:`~minbft_tpu.parallel.EnginePool`, every grid point driven by
+    the PR-10 open-loop harness — a burst probe finds the point's peak,
+    then a SAT (1x) and an OVER (2x) open-loop run emit the
+    ``groups{G}x{C}_load_{sat,over}_*`` curve.  The SAT run carries the
+    pool attribution: ``groups{G}x{C}_verify_mean_batch`` (pool-wide
+    fill of the MAC host lane), per-chip
+    ``groups{G}x{C}_chip{c}_util_busy``/``_util_fill`` + lane census,
+    and the pool-aggregate ``groups{G}x{C}_util_*`` block (whose
+    ``_util_effective_per_sec`` benchgate gates).
+
+    The chips axis CLAMPS to the visible device count — on the CPU
+    container the grid degenerates honestly to C=1 (one unpinned engine
+    per replica, the differential-tested identity path) and the artifact
+    stays stamped ``tpu_unavailable``; the linear-in-chips claim is the
+    real-TPU run's to make.  G starts at 2: the pool threads through the
+    grouped runtime, and the G=1/ungrouped operating point is already
+    the ``load_*`` curve's subject."""
+    from minbft_tpu.loadgen import LoadSpec
+    from minbft_tpu.loadgen.runner import run_local_load
+
+    out: dict = {}
+    n_dev = len(jax.devices())
+    gs = [
+        int(x)
+        for x in os.environ.get("MINBFT_BENCH_GRID_GS", "2,4,8").split(",")
+    ]
+    want = [
+        int(x)
+        for x in os.environ.get(
+            "MINBFT_BENCH_GRID_CHIPS", "1,2,4,8"
+        ).split(",")
+    ]
+    cs = sorted({max(min(c, n_dev), 1) for c in want})
+    out["groups_chips_grid_Gs"] = gs
+    out["groups_chips_grid_chips"] = cs
+    out["groups_chips_requested_chips"] = sorted(set(want))
+    out["groups_chips_devices_visible"] = n_dev
+    seed = int(os.environ.get("MINBFT_LOAD_SEED", "0x10AD"), 0)
+    n_req = int(os.environ.get("MINBFT_BENCH_GRID_REQUESTS", "600"))
+    n_clients = int(os.environ.get("MINBFT_BENCH_GRID_CLIENTS", "400"))
+    probe_rate = float(os.environ.get("MINBFT_LOAD_PROBE_RATE", "3000"))
+
+    def run_point(p, G, C, i, rate, util):
+        spec = LoadSpec(
+            # Distinct deterministic seed per (G, C, stage): benchgate
+            # compares like against like round over round.
+            seed=seed + 1000 * G + 100 * C + i,
+            rate=max(rate, 1.0),
+            duration_s=max(n_req / max(rate, 1.0), 1.0),
+            n_clients=n_clients,
+            n_groups=G,
+            read_fraction=0.1 if util else 0.0,
+        )
+        return asyncio.run(
+            run_local_load(
+                spec,
+                pool_slots=2 if not util and i == 0 else 4,
+                drain_s=60.0,
+                chips=C,
+                pool_util_prefix=p if util else None,
+            )
+        )
+
+    for G in gs:
+        for C in cs:
+            p = f"groups{G}x{C}"
+            try:
+                probe = run_point(p, G, C, 0, probe_rate, util=False)
+                peak = probe["sustained_per_sec"]
+                out[f"{p}_load_burst_peak_per_sec"] = peak
+                for i, (tag, mult) in enumerate(
+                    (("sat", 1.0), ("over", 2.0)), start=1
+                ):
+                    rep = run_point(
+                        p, G, C, i, mult * max(peak, 1.0), util=tag == "sat"
+                    )
+                    lp = f"{p}_load_{tag}"
+                    out[f"{lp}_offered_per_sec"] = round(
+                        mult * max(peak, 1.0), 1
+                    )
+                    out[f"{lp}_goodput_per_sec"] = rep["sustained_per_sec"]
+                    out[f"{lp}_p50_ms"] = rep["p50_ms"]
+                    out[f"{lp}_p99_ms"] = rep["p99_ms"]
+                    out[f"{lp}_census_ok"] = rep["census_ok"]
+                    out[f"{lp}_shed"] = rep["cluster"]["admission_shed"]
+                    out[f"{lp}_busy_sent"] = rep["cluster"][
+                        "admission_busy_sent"
+                    ]
+                    if tag == "sat":
+                        out[f"{p}_chips"] = rep["cluster"]["chips"]
+                        out.update(rep.get("pool_util", {}))
+                        if "pool_placement" in rep:
+                            out[f"{p}_placement"] = rep["pool_placement"]
+            except Exception as e:  # noqa: BLE001 - one failed grid point
+                # must not cost the grid (or the artifact)
+                print(
+                    json.dumps({f"{p}_run": f"failed: {e}"[:300]}),
+                    file=sys.stderr, flush=True,
+                )
+                continue
+    return out
+
+
 def _last_tpu_numbers() -> "dict | None":
     """Carry-forward block for CPU-fallback runs: the newest committed
     BENCH_r*.json produced on a real TPU backend, so a reader of this
@@ -2208,6 +2347,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - the curve is additive
             print(
                 json.dumps({"load_run": f"failed: {e}"[:300]}),
+                file=sys.stderr, flush=True,
+            )
+    if not os.environ.get("MINBFT_BENCH_SKIP_GRID"):
+        # (G, chips) engine-pool grid (ISSUE 17): open-loop curves per
+        # grid point plus per-chip/pool-aggregate attribution.  The
+        # chips axis clamps to visible devices (C=1 on the CPU
+        # container); per-point failures are already swallowed inside.
+        try:
+            extras.update(bench_groups_chips())
+        except Exception as e:  # noqa: BLE001 - the grid is additive
+            print(
+                json.dumps({"grid_run": f"failed: {e}"[:300]}),
                 file=sys.stderr, flush=True,
             )
     if not os.environ.get("MINBFT_BENCH_SKIP_RO"):

@@ -769,6 +769,7 @@ class GroupRuntime(api.Replica):
         logger: Optional[logging.Logger] = None,
         domain_separation: bool = True,
         wrap_group_connector=None,
+        engine_pool=None,
     ):
         if not authenticators:
             raise ValueError("need at least one group authenticator")
@@ -789,8 +790,19 @@ class GroupRuntime(api.Replica):
             f"minbft.replica{replica_id}.groups"
         )
         self._mux = SharedChannelMux(connector, log=self.log)
+        # Multi-device engine pool (ISSUE 17): when provided, each
+        # group's BASE authenticator is late-bound to its home-chip
+        # engine facade (pool placement: group → exactly one chip) so
+        # all groups homed on a chip coalesce into THAT chip's queues —
+        # the PR-8 cross-group fill win, replicated per chip.  Binding
+        # happens before the GroupAuthenticator wrap (the wrapper
+        # delegates, it doesn't copy) and never overrides an engine the
+        # caller already injected.
+        self.engine_pool = engine_pool
         self.cores: List[_Replica] = []
         for g, (auth, consumer) in enumerate(zip(authenticators, consumers)):
+            if engine_pool is not None and hasattr(auth, "bind_engine"):
+                auth.bind_engine(engine_pool.engine_for(g))
             if domain_separation:
                 auth = GroupAuthenticator(auth, g)
             conn_g = self._mux.group_connector(g)

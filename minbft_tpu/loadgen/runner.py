@@ -78,6 +78,8 @@ async def run_local_load(
     batchsize_prepare: int = 64,
     expect_goodput: float = 0.0,
     scheme: str = "mac",
+    chips: Optional[int] = None,
+    pool_util_prefix: Optional[str] = None,
 ) -> dict:
     """Run ``spec`` against a fresh local cluster; returns the report.
 
@@ -89,6 +91,21 @@ async def run_local_load(
     on an OpenSSL-less container pure-Python ECDSA (~10ms/verify) would
     turn every run into a host-crypto benchmark; pass ``ecdsa-p256`` to
     include public-key request auth in the measurement.
+
+    ``chips`` (grouped runs only) threads a multi-device
+    :class:`~minbft_tpu.parallel.EnginePool` through each replica's
+    group runtime — one verify/sign engine per home chip, groups placed
+    round-robin (ISSUE 17).  ``None`` (default) keeps the engine-less
+    path byte-for-byte; any integer (1 included — the pool clamps to
+    the visible device count) builds a pool per replica, routing MAC
+    verifies through each group's home-chip engine (host HMAC lane —
+    batched, no kernel compile, honest on every backend).
+    ``pool_util_prefix`` additionally snapshots replica 0's pool through
+    the PR-9 :class:`~minbft_tpu.obs.ledger.PoolLedger` over the
+    measured run and returns the ``{prefix}_chip{c}_util_*`` /
+    pool-aggregate ``{prefix}_util_*`` keys (plus
+    ``{prefix}_verify_mean_batch``) under ``report["pool_util"]`` —
+    the bench grid merges them into the artifact verbatim.
     """
     from ..core import new_replica
     from ..groups import GroupAuthenticator, new_group_runtime
@@ -130,16 +147,24 @@ async def run_local_load(
     ledgers: list = []
     replicas = []
     servers = []
+    pools = []
     for i in range(n):
         if grouped:
             group_ledgers = [SimpleLedger() for _ in range(spec.n_groups)]
             ledgers.append(group_ledgers)
+            engine_pool = None
+            if chips is not None:
+                from ..parallel import EnginePool
+
+                engine_pool = EnginePool(chips=chips)
+                pools.append(engine_pool)
             r = new_group_runtime(
                 i,
                 cfg,
                 [_replica_auth(store, i) for _ in range(spec.n_groups)],
                 InProcessPeerConnector(stubs),
                 group_ledgers,
+                engine_pool=engine_pool,
             )
         else:
             ledger = SimpleLedger()
@@ -170,6 +195,15 @@ async def run_local_load(
         # schedule and starve the firing loop (everything shares one
         # event loop here).
         await _warmup(spec, n, f, store, addrs)
+
+        # Pool attribution window opens AFTER warmup (the ledger deltas
+        # against its construction-time baseline, so warmup batches
+        # never pollute the measured busy/fill).
+        pool_ledger = None
+        if pools and pool_util_prefix:
+            from ..obs.ledger import PoolLedger
+
+            pool_ledger = PoolLedger(pools[0])
 
         client_ids = list(range(spec.n_clients))
         schedule = None
@@ -210,6 +244,23 @@ async def run_local_load(
             schedule=schedule,
         )
         report = await gen.run()
+        if pool_ledger is not None:
+            # Snapshot before teardown: wall time must cover exactly the
+            # measured run, not the server drain below.  MAC request
+            # auth rides the host HMAC lane of each home-chip engine.
+            queue = (
+                "hmac_sha256_host" if scheme == "mac" else "ecdsa_p256"
+            )
+            util = pool_ledger.util_keys(pool_util_prefix, queue)
+            win = pool_ledger.window(queue)
+            if win is not None:
+                util[f"{pool_util_prefix}_verify_mean_batch"] = round(
+                    win.mean_batch, 2
+                )
+            report["pool_util"] = util
+            report["pool_placement"] = {
+                str(g): c for g, c in sorted(pools[0].placement().items())
+            }
     finally:
         for srv in servers:
             try:
@@ -245,6 +296,8 @@ async def run_local_load(
     report["cluster"] = {
         "n": n,
         "f": f,
+        # Actual pool width (post-clamp) — 1 when no pool was threaded.
+        "chips": pools[0].chips if pools else 1,
         "committed_entries_all_replicas": committed,
         "admission_shed": shed,
         "admission_busy_sent": busy_sent,

@@ -336,14 +336,60 @@ def merge_family_lists(lists: Iterable[List[Family]]) -> List[Family]:
     ]
 
 
+def collect_engine_pool(pool, base: Optional[Dict[str, str]] = None
+                        ) -> List[Family]:
+    """Families for a :class:`minbft_tpu.parallel.EnginePool`: pool
+    width, per-chip utilization (busy fraction and fill efficiency over
+    the window since the LAST scrape — the call rolls the pool's
+    utilization windows, same reset-on-read contract as the depth-peak
+    gauges), per-chip queue depth and liveness, and each group's home
+    chip.  ``peer top`` renders these as per-chip sub-rows under the
+    (replica, group) identity; a chip whose every queue wrote its device
+    off reads ``minbft_engine_pool_chip_up`` 0 (rendered DOWN)."""
+    base = dict(base or {})
+    rows = pool.chip_utilization()
+    busy, fill, depth, up = [], [], [], []
+    for row in rows:
+        lb = {**base, "chip": str(row["chip"])}
+        busy.append((lb, row["busy"]))
+        fill.append((lb, row["fill"]))
+        depth.append((lb, row["depth"]))
+        up.append((lb, 1 if pool.chip_up(row["chip"]) else 0))
+    home = [
+        ({**base, "group": str(g)}, c)
+        for g, c in sorted(pool.placement().items())
+    ]
+    return [
+        ("minbft_engine_pool_chips", "gauge",
+         "home chips in the engine pool (requested clamps to visible "
+         "devices)", [(base, pool.chips)]),
+        ("minbft_engine_pool_chip_busy", "gauge",
+         "per-chip busy fraction since the last scrape (PR-9 ledger "
+         "window over the chip's engine)", busy),
+        ("minbft_engine_pool_chip_fill", "gauge",
+         "per-chip fill efficiency since the last scrape (1.0 under a "
+         "self ceiling)", fill),
+        ("minbft_engine_pool_chip_depth", "gauge",
+         "items pending across the chip engine's verify+sign queues",
+         depth),
+        ("minbft_engine_pool_chip_up", "gauge",
+         "0 when every queue on the chip has written its device off "
+         "(host-fallback only — the chip is effectively DOWN)", up),
+        ("minbft_engine_pool_home_chip", "gauge",
+         "each consensus group's home chip (placement map)", home),
+    ]
+
+
 def collect_group_runtime(runtime, engine=None, replica_id=None,
-                          timeseries=None) -> List[Family]:
+                          timeseries=None, engine_pool=None) -> List[Family]:
     """Families for a :class:`minbft_tpu.groups.GroupRuntime`: one
     ``collect_replica`` per group core (every series carries its
     ``group`` label), the shared engine's families once (its queues
     really are shared — splitting them per group would double-count).
     The time-series rings and the stale-group health gauge are
-    process-level and likewise emitted once."""
+    process-level and likewise emitted once.  ``engine_pool`` (explicit,
+    or the runtime's own ``engine_pool`` attribute) adds the
+    ``minbft_engine_pool_*`` per-chip families."""
     n_groups = len(runtime.cores)
     lists = [
         collect_replica(
@@ -362,6 +408,11 @@ def collect_group_runtime(runtime, engine=None, replica_id=None,
             collect_replica(timeseries=timeseries, replica_id=replica_id)
         )
     fams = merge_family_lists(lists)
+    if engine_pool is None:
+        engine_pool = getattr(runtime, "engine_pool", None)
+    if engine_pool is not None:
+        base = {} if replica_id is None else {"replica": str(replica_id)}
+        fams.extend(collect_engine_pool(engine_pool, base))
     stale_fn = getattr(runtime, "stale_groups", None)
     if stale_fn is not None:
         base = {} if replica_id is None else {"replica": str(replica_id)}

@@ -10,5 +10,6 @@ engine coalesces them into fixed-shape batches dispatched to one XLA kernel
 """
 
 from .engine import BatchVerifier, SignStats, VerifyStats
+from .pool import EnginePool
 
-__all__ = ["BatchVerifier", "SignStats", "VerifyStats"]
+__all__ = ["BatchVerifier", "EnginePool", "SignStats", "VerifyStats"]

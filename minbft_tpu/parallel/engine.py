@@ -646,6 +646,7 @@ class BatchVerifier:
         dispatch_timeout: float = 90.0,
         dedup: bool = True,
         sign_on_device: Optional[bool] = None,
+        device=None,
     ):
         # Sign-queue device placement.  None = auto: the device sign
         # kernels (fixed-base comb k*G / r*B) only beat serial host
@@ -673,6 +674,15 @@ class BatchVerifier:
         # per-chip programs out over ICI (BASELINE config[4]'s scaling
         # axis).  A 1-device mesh degenerates to the single-chip kernels.
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
+        # Home-chip pinning (the multi-device engine pool): a jax device
+        # this engine's kernel dispatches run on.  None keeps jax's
+        # default placement — byte-identical to the pre-pool engine, and
+        # the only mode the C=1 pool uses.  Mutually exclusive with
+        # ``mesh`` by construction: a mesh-routed engine stripes across
+        # chips, a pinned engine owns one.
+        if device is not None and self.mesh is not None:
+            raise ValueError("pass either device= (home chip) or mesh=, not both")
+        self.device = device
         self._sharded_kernels: Dict[str, object] = {}
         self._sharded_lock = threading.Lock()
         # Stats fields are owned per-field: the event loop owns the counts
@@ -801,6 +811,20 @@ class BatchVerifier:
             if reset:
                 q.peak_depth = len(q.pending)  # noqa: LD001
         return out
+
+    def _device_scope(self):
+        """Placement scope for one dispatch: ``jax.default_device`` bound
+        to the engine's home chip, or a no-op when unpinned.  Entered on
+        the WORKER thread around the kernel call — jax's config scopes
+        are thread-local, so concurrent engines pinned to different
+        chips never fight over a global default."""
+        if self.device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self.device)
 
     def _sharded(self, name: str, builder):
         # Dispatchers run on worker threads (max_inflight > 1): lock the
@@ -1061,8 +1085,9 @@ class BatchVerifier:
 
                 kernel = self._sharded("ecdsa", mesh_mod.sharded_ecdsa_kernel)
                 return np.asarray(kernel(packed))[:n]
-            out = p256.ecdsa_verify_kernel_packed(jnp.asarray(packed))
-            return np.asarray(out)[:n]
+            with self._device_scope():
+                out = p256.ecdsa_verify_kernel_packed(jnp.asarray(packed))
+                return np.asarray(out)[:n]
         finally:
             self._staging.release(staging)
 
@@ -1089,8 +1114,9 @@ class BatchVerifier:
 
                 kernel = self._sharded("hmac", mesh_mod.sharded_hmac_kernel)
                 return np.asarray(kernel(staging))[:n]
-            out = hmac_verify_kernel_packed(jnp.asarray(staging))
-            return np.asarray(out)[:n]
+            with self._device_scope():
+                out = hmac_verify_kernel_packed(jnp.asarray(staging))
+                return np.asarray(out)[:n]
         finally:
             self._staging.release(staging)
 
@@ -1111,8 +1137,9 @@ class BatchVerifier:
 
                 kernel = self._sharded("ed25519", mesh_mod.sharded_ed25519_kernel)
                 return np.asarray(kernel(packed))[:n]
-            out = ed.ed25519_verify_kernel_packed(jnp.asarray(packed))
-            return np.asarray(out)[:n]
+            with self._device_scope():
+                out = ed.ed25519_verify_kernel_packed(jnp.asarray(packed))
+                return np.asarray(out)[:n]
         finally:
             self._staging.release(staging)
 
@@ -1140,7 +1167,8 @@ class BatchVerifier:
                 )
             else:
                 kernel = p256.ecdsa_kg_kernel
-            xz = np.asarray(kernel(k_arr))
+            with self._device_scope():
+                xz = np.asarray(kernel(k_arr))
             t1 = time.perf_counter()
             sigs = p256.sign_finish(items, meta, xz)
             prep += time.perf_counter() - t1
@@ -1167,7 +1195,8 @@ class BatchVerifier:
                 )
             else:
                 kernel = ed.ed25519_rb_kernel
-            xyz = np.asarray(kernel(r_arr))
+            with self._device_scope():
+                xyz = np.asarray(kernel(r_arr))
             t1 = time.perf_counter()
             sigs = ed.sign_finish(meta, xyz)
             prep += time.perf_counter() - t1

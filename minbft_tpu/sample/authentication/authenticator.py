@@ -286,6 +286,17 @@ class SampleAuthenticator(api.Authenticator):
         # unaffected by design — see generate_message_authen_tag_async.
         self._batch_sign = batch_sign
 
+    def bind_engine(self, engine) -> None:
+        """Late-bind a batching engine (or an engine-pool facade) onto an
+        engine-less authenticator.  The multi-group runtime uses this to
+        hand each group's base authenticator its HOME-CHIP engine after
+        placement: the authenticator was constructed before the pool
+        (key material first, placement later).  A no-op when an engine
+        was already injected at construction — an explicit per-replica
+        engine wins over pool placement."""
+        if self._engine is None and engine is not None:
+            self._engine = engine
+
     # -- generation ---------------------------------------------------------
 
     def generate_message_authen_tag(

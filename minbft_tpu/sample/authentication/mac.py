@@ -137,6 +137,17 @@ class MacAuthenticator(api.Authenticator):
         self._engine = engine
         self._device_macs = device_macs
 
+    def bind_engine(self, engine) -> None:
+        """Late-bind a batching engine (engine-pool home-chip facade):
+        MAC checks then ride its host HMAC lane (``device_macs`` still
+        decides device placement), and the inner USIG authenticator gets
+        the same binding.  No-op when an engine was already injected —
+        same contract as :meth:`SampleAuthenticator.bind_engine`."""
+        if self._engine is None and engine is not None:
+            self._engine = engine
+        if self._inner is not None and hasattr(self._inner, "bind_engine"):
+            self._inner.bind_engine(engine)
+
     # -- generation ---------------------------------------------------------
 
     def generate_message_authen_tag(

@@ -44,7 +44,7 @@ def _env(name: str, fallback, choices=None):
 _PEER_OPTION_SCHEMA = {
     None: {"keys", "config", "log_level", "log_file", "auth", "transport"},
     "run": {"listen", "batch", "metrics_interval", "metrics_port",
-            "metrics_host", "groups"},
+            "metrics_host", "groups", "chips"},
     "request": {"client_id", "timeout", "group"},
 }
 
@@ -219,6 +219,17 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         "README §Sharding).  0 (default) = the config's protocol.groups "
         "value; 1 = the plain ungrouped runtime.  Must be identical "
         "cluster-wide.",
+    )
+    r.add_argument(
+        "--chips",
+        type=int,
+        default=_opt("chips", 1, section="run"),
+        help="home chips for the multi-device engine pool (grouped "
+        "runtime only): each consensus group's verify/sign traffic is "
+        "placed on one chip's engine (perf/SHARDING.md §multi-chip).  "
+        "0 = all visible devices; clamps to the device count; 1 "
+        "(default) = the single shared engine.  Ignored with --no-batch "
+        "or on the CPU backend (same rule as --batch).",
     )
     r.add_argument(
         "--peer-idle-timeout",
@@ -524,6 +535,23 @@ async def _run_replica(args) -> int:
             conn.connect_replica(rid, addr)
     n_groups = args.groups if args.groups > 0 else getattr(cfg, "groups", 1)
     grouped = n_groups > 1
+    engine_pool = None
+    if grouped and engine is not None and getattr(args, "chips", 1) != 1:
+        # Multi-device engine pool (ISSUE 17): one engine per home chip,
+        # groups placed round-robin; replaces the single shared engine.
+        # The pool clamps to the visible device count, so --chips 8 on a
+        # 1-device host degrades honestly to the C=1 (single-engine)
+        # behaviour.  Authenticators are constructed engine-less here and
+        # late-bound to their group's home-chip facade by the runtime.
+        import jax
+
+        from ...parallel import EnginePool
+
+        chips = args.chips if args.chips > 0 else len(jax.devices())
+        engine_pool = EnginePool(
+            chips=chips, max_batch=args.batch, buckets=(args.batch,)
+        )
+        engine = None
     if grouped:
         # Multi-group runtime (README §Sharding): G independent group
         # cores over this one listener + peer connection set, every
@@ -547,6 +575,7 @@ async def _run_replica(args) -> int:
             conn,
             [SimpleLedger() for _ in range(n_groups)],
             logger=ropts.logger,
+            engine_pool=engine_pool,
         )
     else:
         ledger = SimpleLedger()
@@ -590,6 +619,9 @@ async def _run_replica(args) -> int:
         if engine is not None:
             # once per engine — the grouped cores share it
             obs_ts.register_engine_series(sampler, engine)
+        elif engine_pool is not None:
+            # the pool exposes the same merged stats/depth surfaces
+            obs_ts.register_engine_series(sampler, engine_pool)
 
     metrics_server = None
     if args.metrics_port >= 0:
@@ -600,9 +632,15 @@ async def _run_replica(args) -> int:
             # the shared engine's families ride once (see
             # obs.prom.collect_group_runtime).
             def render() -> str:
+                # The pool stands in for the shared engine: its merged
+                # stats carry c{chip}:-prefixed queue names, and the
+                # runtime's engine_pool adds the minbft_engine_pool_*
+                # per-chip families.
                 return obs_prom.render_families(
                     obs_prom.collect_group_runtime(
-                        replica, engine=engine, replica_id=args.id,
+                        replica,
+                        engine=engine if engine is not None else engine_pool,
+                        replica_id=args.id,
                         timeseries=tseries,
                     )
                 )
@@ -1358,6 +1396,22 @@ def _scrape_top_state(addr: str, timeout: float) -> dict:
     for key, _v in samples("minbft_build_info").items():
         lb = dict(key)
         state["build"][(lb.get("replica", "?"), lb.get("group", "-"))] = lb
+    # Engine-pool per-chip readings (ISSUE 17): keyed (replica, chip).
+    # Absent families leave the dicts empty — a pool-less target renders
+    # exactly as before.
+    chips: dict = {}
+    for fam_name, field in (
+        ("minbft_engine_pool_chip_busy", "busy"),
+        ("minbft_engine_pool_chip_fill", "fill"),
+        ("minbft_engine_pool_chip_depth", "depth"),
+        ("minbft_engine_pool_chip_up", "up"),
+    ):
+        for key, v in samples(fam_name).items():
+            lb = dict(key)
+            ident = (lb.get("replica", "?"), lb.get("chip", "?"))
+            chips.setdefault(ident, {})[field] = v
+    state["chips"] = chips
+    state["home_chip"] = by_identity("minbft_engine_pool_home_chip")
     for name, fam in fams.items():
         if name.startswith("minbft_window_"):
             state["window"][name[len("minbft_window_"):]] = next(
@@ -1459,6 +1513,22 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
                 f"{st['peak']:>6.0f}{lag:>8.2f}{view:>5}"
                 f"  {' '.join(flags) or 'ok'}"
             )
+            # Engine-pool expansion (ISSUE 17): the group's home chip as
+            # a sub-row.  A chip the scrape knows nothing about (or one
+            # whose every queue wrote its device off) renders DOWN with
+            # zeroed readings — missing fields must never crash a frame.
+            home = st.get("home_chip", {}).get(ident)
+            if home is not None:
+                chip = str(int(home))
+                row = st.get("chips", {}).get((rid, chip), {})
+                down = not row or not row.get("up", 0)
+                lines.append(
+                    f"{'':<24} └ chip {chip:<3}"
+                    f" busy={row.get('busy', 0.0):<7.3f}"
+                    f" fill={row.get('fill', 0.0):<7.3f}"
+                    f" depth={row.get('depth', 0.0):<6.0f}"
+                    f" {'DOWN' if down else 'up'}"
+                )
         build = next(iter(st["build"].values()), None)
         if build is not None:
             lines.append(

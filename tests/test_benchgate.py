@@ -235,3 +235,86 @@ def test_groups_sweep_headline_is_gated():
     report = benchgate.compare(base, cand)
     assert [r.key for r in report.results] == ["e2e", "groups1", "groups4"]
     assert [r.status for r in report.results] == ["ok", "ok", "regression"]
+
+
+def test_grid_load_goodput_and_p99_join_the_gate():
+    """ISSUE 17: the (G, chips) grid's embedded per-point curves
+    (groups{G}x{C}_load_*) gate exactly like the top-level load_* curve —
+    goodput on drop, p99 on increase."""
+    base = _artifact(
+        100.0,
+        groups4x2_load_sat_goodput_per_sec=500.0,
+        groups4x2_load_sat_p99_ms=1000.0,
+    )
+    cand = dict(base)
+    cand["groups4x2_load_sat_goodput_per_sec"] = 100.0  # -80%
+    cand["groups4x2_load_sat_p99_ms"] = 9000.0  # +800% > the 150% floor
+    report = benchgate.compare(base, cand)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["groups4x2_load_sat_goodput"].status == "regression"
+    assert by_key["groups4x2_load_sat_goodput"].direction == "drop"
+    assert by_key["groups4x2_load_sat_p99"].status == "regression"
+    assert by_key["groups4x2_load_sat_p99"].direction == "increase"
+    # inside both floors: noise, not regression
+    ok_cand = dict(base)
+    ok_cand["groups4x2_load_sat_goodput_per_sec"] = 400.0  # -20% < 30%
+    ok_cand["groups4x2_load_sat_p99_ms"] = 2000.0  # 2x < 1.5x-increase
+    assert benchgate.compare(base, ok_cand).ok
+
+
+def test_grid_pool_aggregate_util_is_gated():
+    """The grid's pool-aggregate utilization headline
+    (groups{G}x{C}_util_effective_per_sec) rides the utilization rule —
+    a collapse regresses; per-chip attribution keys stay ungated."""
+    base = _artifact(
+        100.0,
+        groups4x2_util_effective_per_sec=8000.0,
+        groups4x2_chip0_util_busy=0.9,
+    )
+    cand = dict(base)
+    cand["groups4x2_util_effective_per_sec"] = 2000.0  # -75%
+    cand["groups4x2_chip0_util_busy"] = 0.01  # diagnostic only
+    report = benchgate.compare(base, cand)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["groups4x2_util"].status == "regression"
+    assert "groups4x2_chip0_util_busy" not in {
+        r.key for r in report.results
+    }
+
+
+def test_grid_load_namespace_is_anchored():
+    """The grid pattern matches ONLY groups{G}x{C}_load_* — a plain
+    groups{G}_* sweep key or a lookalike elsewhere in the name never
+    joins the load gate."""
+    assert benchgate._in_load_namespace("groups8x4_load_sat_p99_ms")
+    assert benchgate._in_load_namespace("load_over_goodput_per_sec")
+    assert not benchgate._in_load_namespace("groups8_load_sat_p99_ms")
+    assert not benchgate._in_load_namespace("groups8x_load_sat_p99_ms")
+    assert not benchgate._in_load_namespace("xgroups8x4_load_sat_p99_ms")
+    base = _artifact(
+        100.0,
+        groups8_p99_ms=5.0,  # sweep diagnostic, not a grid curve
+        groups8x4_extra_goodput_per_sec=9.0,  # not under _load_
+    )
+    cand = dict(base)
+    cand["groups8_p99_ms"] = 500.0
+    cand["groups8x4_extra_goodput_per_sec"] = 0.1
+    report = benchgate.compare(base, cand)
+    assert [r.key for r in report.results] == ["e2e"]
+
+
+def test_grid_keys_respect_backend_refusal():
+    """Cross-backend refusal covers grid keys: a CPU grid artifact never
+    gates against a chip baseline, even when only grid keys differ."""
+    tpu_base = _artifact(
+        1000.0, backend="tpu", tpu_unavailable=False,
+        groups4x8_load_sat_goodput_per_sec=90000.0,
+        groups4x8_util_effective_per_sec=500000.0,
+    )
+    cpu_cand = _artifact(
+        5.0,
+        groups4x1_load_sat_goodput_per_sec=300.0,
+        groups4x1_util_effective_per_sec=2000.0,
+    )
+    with pytest.raises(BackendMismatch):
+        benchgate.compare(tpu_base, cpu_cand)

@@ -437,6 +437,30 @@ def default_config() -> AnalyzeConfig:
                 locks=(),
                 guarded=("pending",),
             ),
+            # Multi-device engine pool (ISSUE 17): placement, facade
+            # cache, in-flight counters, and the rolling attribution
+            # ledgers are all event-loop confined BY CONTRACT — the pool
+            # routes; the per-chip BatchVerifiers own all the real
+            # thread crossings.  A suspend-crossing mutation here would
+            # tear rebalance's in-flight check against a dispatch.
+            LockClassSpec(
+                path="minbft_tpu/parallel/pool.py",
+                cls="EnginePool",
+                locks=(),
+                guarded=(
+                    "_placement",
+                    "_facades",
+                    "_inflight",
+                    "_util_ledgers",
+                    "_ceilings",
+                ),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/parallel/pool.py",
+                cls="_GroupEngine",
+                locks=(),
+                guarded=("group",),
+            ),
             # Flight-recorder rings (obs/trace.py, ISSUE 4).  StageRing
             # is SINGLE-writer by contract — only the owning event loop
             # pushes — so it is loop-confined with no lock; MTStageRing

@@ -16,7 +16,10 @@ Scope — deliberately narrow and honest:
   like a throughput mean, and ``load_*_p99_ms`` gated on INCREASE — a
   latency key regresses when the candidate climbs past the allowance,
   with its own (wider) relative floor because single-seed tail latency
-  swings far more than committed throughput does.
+  swings far more than committed throughput does.  The (G, chips) grid's
+  embedded per-point curves (``groups{G}x{C}_load_*``, ISSUE 17) join
+  the same two rules, and its pool-aggregate
+  ``groups{G}x{C}_util_effective_per_sec`` rides the utilization rule.
 - A key regresses when its drop exceeds BOTH noise defenses:
   ``drop > max(sigmas * sqrt(base_std² + cand_std²),
   rel_floor * base_mean)`` — the stddev band covers measured run-to-run
@@ -40,6 +43,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
+import re
 from typing import Dict, List, Tuple
 
 DEFAULT_SIGMAS = 3.0
@@ -57,11 +61,19 @@ _STD_SUFFIX = "_req_per_sec_stddev"
 _UTIL_SUFFIX = "_util_effective_per_sec"
 # Open-loop curve headlines (ISSUE 15).  Goodput gates on drop like any
 # throughput key; p99 gates on INCREASE (lower is better).  Both are
-# restricted to the ``load_`` namespace so unrelated future keys ending
-# in ``_per_sec`` / ``_ms`` don't silently join the gate.
+# restricted to the load namespaces so unrelated future keys ending
+# in ``_per_sec`` / ``_ms`` don't silently join the gate: the top-level
+# ``load_*`` curve, plus the (G, chips) grid's embedded per-point curves
+# ``groups{G}x{C}_load_*`` (ISSUE 17 — the pattern is anchored, so a
+# plain ``groups{G}_*`` sweep key can never match it).
 _LOAD_PREFIX = "load_"
+_GRID_LOAD_RE = re.compile(r"^groups\d+x\d+_load_")
 _LOAD_GOODPUT_SUFFIX = "_goodput_per_sec"
 _LOAD_P99_SUFFIX = "_p99_ms"
+
+
+def _in_load_namespace(key: str) -> bool:
+    return key.startswith(_LOAD_PREFIX) or bool(_GRID_LOAD_RE.match(key))
 
 
 class BackendMismatch(Exception):
@@ -128,11 +140,11 @@ def gated_pairs(
             # compare() then misses by construction and reads 0.0 —
             # exactly the single-run semantics the rel_floor covers
             prefix = key[: -len(_UTIL_SUFFIX)] + "_util"
-        elif key.startswith(_LOAD_PREFIX) and key.endswith(
+        elif _in_load_namespace(key) and key.endswith(
             _LOAD_GOODPUT_SUFFIX
         ):
             prefix = key[: -len("_per_sec")]
-        elif key.startswith(_LOAD_PREFIX) and key.endswith(
+        elif _in_load_namespace(key) and key.endswith(
             _LOAD_P99_SUFFIX
         ):
             prefix = key[: -len("_ms")]
