@@ -117,11 +117,17 @@ bench_extras line carries the headline-grade subset):
   load_{half,sat,over}_shed / _busy_sent / _busy_received / _rx_peak
       the latency-vs-offered-load curve at 0.5x / 1x / 1.5x of peak —
       benchgate gates the goodput (drop) and p99 (rise) headlines
+  load_{half,sat,over}_finality_p99_ms / _slo_good_fraction
+      the SLO surface per curve point (perf/SLO.md): scheduled-origin
+      finality p99 with unresolved requests charged their age-so-far,
+      and the fraction of FIRED requests inside the finality budget —
+      benchgate gates the finality p99 on increase
   load_over_goodput_fraction   goodput retained at 1.5x overload (the
       admission-control graceful-degradation claim, as a fraction)
   groups{G}x{C}_load_{sat,over}_offered_per_sec / _goodput_per_sec /
   groups{G}x{C}_load_{sat,over}_p50_ms / _p99_ms / _census_ok / _shed /
-  groups{G}x{C}_load_{sat,over}_busy_sent
+  groups{G}x{C}_load_{sat,over}_busy_sent /
+  groups{G}x{C}_load_{sat,over}_finality_p99_ms / _slo_good_fraction
       (G, chips) engine-pool grid (bench_groups_chips, ISSUE 17): G
       groups round-robin over a C-chip EnginePool (one engine per home
       chip), each grid point its own open-loop curve — a burst probe
@@ -1994,6 +2000,8 @@ def bench_load() -> dict:
         out[f"{p}_p50_ms"] = rep["p50_ms"]
         out[f"{p}_p99_ms"] = rep["p99_ms"]
         out[f"{p}_send_p99_ms"] = rep["send_p99_ms"]
+        out[f"{p}_finality_p99_ms"] = rep["finality_p99_ms"]
+        out[f"{p}_slo_good_fraction"] = rep["slo_good_fraction"]
         out[f"{p}_timeouts"] = rep["timeouts"]
         out[f"{p}_census_ok"] = rep["census_ok"]
         out[f"{p}_busy_received"] = rep["busy_received"]
@@ -2107,6 +2115,10 @@ def bench_groups_chips() -> dict:
                     out[f"{lp}_goodput_per_sec"] = rep["sustained_per_sec"]
                     out[f"{lp}_p50_ms"] = rep["p50_ms"]
                     out[f"{lp}_p99_ms"] = rep["p99_ms"]
+                    out[f"{lp}_finality_p99_ms"] = rep["finality_p99_ms"]
+                    out[f"{lp}_slo_good_fraction"] = rep[
+                        "slo_good_fraction"
+                    ]
                     out[f"{lp}_census_ok"] = rep["census_ok"]
                     out[f"{lp}_shed"] = rep["cluster"]["admission_shed"]
                     out[f"{lp}_busy_sent"] = rep["cluster"][

@@ -63,6 +63,7 @@ from . import timeout as timeout_mod
 from . import checkpoint as checkpoint_mod
 from . import usig_ui, utils
 from . import viewchange as viewchange_mod
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..utils.backoff import ReconnectBackoff
 from ..utils.metrics import ReplicaMetrics
@@ -200,6 +201,21 @@ class Handlers:
         self.trace = (
             obs_trace.FlightRecorder.for_replica(replica_id, group=group)
             if (getattr(configer, "trace", False) or obs_trace.tracing_enabled())
+            else None
+        )
+        # Latency-SLO budget ledger (obs/slo.py): recv-origin
+        # good/breached classification at commit-quorum time against the
+        # per-group finality budget.  None unless the operator opted in
+        # (configer slo fields from consensus.yaml, or the MINBFT_SLO_*
+        # env knobs) — every hook below is then ONE predicated attribute
+        # check (`if sl is not None`), the flight recorder's
+        # disabled-cost contract.
+        self.slo = (
+            obs_slo.BudgetLedger(
+                obs_slo.SLOPolicy.from_env(group=group, configer=configer),
+                group=group,
+            )
+            if obs_slo.slo_enabled(configer)
             else None
         )
 
@@ -444,6 +460,19 @@ class Handlers:
         else:
             trace_prepare = trace_quorum = None
             trace_execute = trace_reply_sign = None
+
+        if self.slo is not None:
+            # Chain the budget classifier onto the commit-quorum capture
+            # point: the pipeline factories still see ONE callable (and
+            # pay one predicated check when both recorder and SLO are
+            # off — the callable stays None).
+            _sl = self.slo
+            _tq = trace_quorum
+
+            def trace_quorum(req: Request) -> None:  # noqa: F811
+                if _tq is not None:
+                    _tq(req)
+                _sl.commit(req.client_id, req.seq)
 
         base_execute = request_mod.make_request_executor(
             replica_id,
@@ -1606,6 +1635,9 @@ class Handlers:
         tr = self.trace
         if tr is not None:
             tr.note(obs_trace.R_RECV, msg.client_id, msg.seq)
+        sl = self.slo
+        if sl is not None:
+            sl.arrive(msg.client_id, msg.seq)
         await self.validate_message(msg)
         if tr is not None:
             tr.note(obs_trace.R_VERIFY_DONE, msg.client_id, msg.seq)
@@ -2022,6 +2054,11 @@ class _BundleIngestor:
                 for m in decoded:
                     if isinstance(m, Request):
                         tr.note(obs_trace.R_INGEST, m.client_id, m.seq)
+            sl = h.slo
+            if sl is not None:
+                for m in decoded:
+                    if isinstance(m, Request):
+                        sl.arrive(m.client_id, m.seq)
             self._preverify(decoded)
         for m in decoded:
             await self._submit(m)

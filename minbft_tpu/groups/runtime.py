@@ -585,6 +585,11 @@ class _GroupBundleIngestor(_BundleIngestor):
                 for m in msgs:
                     if isinstance(m, Request):
                         tr.note(obs_trace.R_INGEST, m.client_id, m.seq)
+            sl = h.slo
+            if sl is not None:
+                for m in msgs:
+                    if isinstance(m, Request):
+                        sl.arrive(m.client_id, m.seq)
             h.preverify_requests(msgs)
             states.append((st, msgs))
         for st, msgs in states:

@@ -139,6 +139,7 @@ class OpenLoopGenerator:
         drain_s: float = _DEFAULT_DRAIN_S,
         verify_replies: bool = False,
         schedule: Optional[Schedule] = None,
+        slo_target_ms: Optional[float] = None,
     ):
         if len(client_ids) < spec.n_clients:
             raise ValueError(
@@ -177,6 +178,14 @@ class OpenLoopGenerator:
         self._start_mono = 0.0
         self._fired = 0
         self._late_fire_max_s = 0.0
+        # Finality budget for the report's SLO keys: explicit target, or
+        # the env/config-resolved policy default (so the bench emits the
+        # keys at every curve point without new plumbing).
+        if slo_target_ms is None:
+            from ..obs.slo import SLOPolicy
+
+            slo_target_ms = SLOPolicy.from_env().target_ms
+        self._slo_target_ms = float(slo_target_ms)
 
     # -- wire plumbing ------------------------------------------------------
 
@@ -455,6 +464,19 @@ class OpenLoopGenerator:
             send_lat.append(p.resolve_mono - p.send_mono)
         p50, p99 = self._percentiles(sched_lat)
         send_p50, send_p99 = self._percentiles(send_lat)
+        # Finality series (obs/slo.py semantics): every FIRED request is
+        # charged from its SCHEDULED arrival; still-unresolved requests
+        # contribute their age-so-far — a finite, honest lower bound that
+        # diverges from p99_ms exactly under overload, where dropping
+        # timeouts would flatter the tail (coordinated omission again,
+        # one level up).
+        now = time.monotonic()
+        finality = list(sched_lat)
+        for p in self._pending.values():
+            finality.append(now - (self._start_mono + p.sched_s))
+        _, finality_p99 = self._percentiles(finality)
+        target_s = self._slo_target_ms / 1e3
+        good = sum(1 for lat in sched_lat if lat <= target_s)
         resolved = len(self._resolved)
         expected = self.schedule.census()
         # Wall-clock-honest committed rate: resolved over the span to the
@@ -482,6 +504,11 @@ class OpenLoopGenerator:
             "sustained_per_sec": round(resolved / wall_s, 3),
             "p50_ms": round(p50 * 1e3, 3),
             "p99_ms": round(p99 * 1e3, 3),
+            # SLO surface (perf/SLO.md): unresolved requests count as
+            # breached, so good_fraction is over FIRED, not resolved.
+            "slo_target_ms": round(self._slo_target_ms, 3),
+            "finality_p99_ms": round(finality_p99 * 1e3, 3),
+            "slo_good_fraction": round(good / max(self._fired, 1), 6),
             # Send-origin counterfactual (coordinated-omission witness):
             # the REPORTED p50/p99 above are scheduled-origin.
             "send_p50_ms": round(send_p50 * 1e3, 3),
@@ -494,3 +521,52 @@ class OpenLoopGenerator:
             "schedule_digest": self.schedule.digest,
             "seed": self.spec.seed,
         }
+
+    def sched_doc(self) -> dict:
+        """Scheduled-origin metadata doc for :func:`obs.slo.breach_report`:
+        per-request finality from the SCHEDULED arrival, keyed
+        ``"cid:seq"``.  Feeding this alongside replica trace dumps
+        upgrades breach classification from recv-origin to
+        scheduled-origin (the coordinated-omission rule of perf/LOAD.md
+        applied to the forensics path, not just the percentile path)."""
+        sched_lat_ns = {}
+        for p in self._resolved:
+            cid, seq = p.key  # (client_id, seq) — a public identity pair
+            sched_lat_ns[f"{cid}:{seq}"] = int(
+                (p.resolve_mono - (self._start_mono + p.sched_s)) * 1e9
+            )
+        return {
+            "kind": "loadgen",
+            "slo_target_ms": self._slo_target_ms,
+            "schedule_digest": self.schedule.digest,
+            "sched_lat_ns": sched_lat_ns,
+        }
+
+    def slo_ring(self, interval_s: float = 1.0):
+        """Replay the run's good/breached classifications into a
+        :class:`~minbft_tpu.obs.timeseries.TimeSeries` ring, so
+        :func:`obs.slo.burn_rates` reads post-hoc burn exactly as a
+        live sampler would have.  Ring slots are wall-clock (the
+        TimeSeries convention), so monotonic resolve stamps are shifted
+        by the current mono->wall offset; still-unresolved fired
+        requests land as breached in the current (newest) slot."""
+        from ..obs.timeseries import TimeSeries
+
+        span = time.monotonic() - self._start_mono if self._start_mono else 0
+        ts = TimeSeries(
+            interval_s=interval_s,
+            capacity=max(512, int(span / interval_s) + 64),
+        )
+        wall_off = time.time() - time.monotonic()
+        target_s = self._slo_target_ms / 1e3
+        for p in self._resolved:
+            lat = p.resolve_mono - (self._start_mono + p.sched_s)
+            ts.record(
+                "slo_good" if lat <= target_s else "slo_breached",
+                1,
+                "rate",
+                t=p.resolve_mono + wall_off,
+            )
+        for p in self._pending.values():
+            ts.record("slo_breached", 1, "rate")
+        return ts

@@ -80,6 +80,8 @@ async def run_local_load(
     scheme: str = "mac",
     chips: Optional[int] = None,
     pool_util_prefix: Optional[str] = None,
+    slo_target_ms: Optional[float] = None,
+    slo_objective: Optional[float] = None,
 ) -> dict:
     """Run ``spec`` against a fresh local cluster; returns the report.
 
@@ -106,6 +108,15 @@ async def run_local_load(
     pool-aggregate ``{prefix}_util_*`` keys (plus
     ``{prefix}_verify_mean_batch``) under ``report["pool_util"]`` —
     the bench grid merges them into the artifact verbatim.
+
+    ``slo_target_ms`` stamps ``slo_ok`` (good_fraction >= objective)
+    into the report — the optional third leg of the ``peer load`` rc
+    contract; ``slo_objective`` defaults to the env/config-resolved
+    :class:`~minbft_tpu.obs.slo.SLOPolicy` objective (0.99).  When
+    ``MINBFT_SLO_DUMP`` names a spool directory, a run that breached
+    its objective hands ONE rate-limited forensic bundle (replica
+    flight-recorder docs, scheduled-origin loadgen metadata, burn
+    rates replayed from the run) to the breach spool.
     """
     from ..core import new_replica
     from ..groups import GroupAuthenticator, new_group_runtime
@@ -242,8 +253,12 @@ async def run_local_load(
             drain_s=drain_s,
             verify_replies=verify_replies,
             schedule=schedule,
+            slo_target_ms=slo_target_ms,
         )
         report = await gen.run()
+        # Breach forensics BEFORE teardown: the bundle reads the live
+        # replicas' flight recorders and SLO ledgers.
+        _slo_forensics(report, gen, replicas, grouped, f, slo_objective)
         if pool_ledger is not None:
             # Snapshot before teardown: wall time must cover exactly the
             # measured run, not the server drain below.  MAC request
@@ -311,4 +326,73 @@ async def run_local_load(
     if expect_goodput > 0:
         report["expect_goodput_per_sec"] = expect_goodput
         report["goodput_ok"] = report["goodput_per_sec"] >= expect_goodput
+    if slo_target_ms is not None:
+        from ..obs.slo import SLOPolicy
+
+        if slo_objective is None:
+            slo_objective = SLOPolicy.from_env().objective
+        report["slo_objective"] = slo_objective
+        report["slo_ok"] = report["slo_good_fraction"] >= slo_objective
     return report
+
+
+def _slo_forensics(
+    report: dict,
+    gen: OpenLoopGenerator,
+    replicas,
+    grouped: bool,
+    f: int,
+    slo_objective: Optional[float] = None,
+) -> None:
+    """Hand the breach spool one bundle when the run breached and
+    ``MINBFT_SLO_DUMP`` asked for forensics.  The burn rates come from
+    replaying the run's scheduled-origin classifications into a ring
+    (:meth:`OpenLoopGenerator.slo_ring`); the trace docs come from the
+    live replicas' flight recorders (empty unless ``MINBFT_TRACE`` was
+    also on); the scheduled-origin loadgen metadata doc rides along so
+    :func:`~minbft_tpu.obs.slo.breach_report` classifies at the
+    coordinated-omission-honest origin.  The policy is the RUN's: the
+    generator's effective target (a ``slo_target_ms`` argument beats the
+    env) and the caller's objective when given — the bundle must explain
+    the breach that was actually declared, not the env default's."""
+    import dataclasses
+
+    from ..obs import slo as obs_slo
+
+    spool = obs_slo.BreachSpool.from_env()
+    if spool is None:
+        return
+    policy = obs_slo.SLOPolicy.from_env()
+    policy = dataclasses.replace(
+        policy,
+        target_ms=gen._slo_target_ms,
+        objective=(
+            slo_objective if slo_objective is not None else policy.objective
+        ),
+    )
+    if report["slo_good_fraction"] >= policy.objective:
+        return
+    ts = gen.slo_ring()
+    burn = obs_slo.burn_rates(ts, policy)
+    recorders = []
+    ledgers = []
+    for r in replicas:
+        cores = r.cores if grouped else [r]
+        for core in cores:
+            h = core.handlers
+            if getattr(h, "trace", None) is not None:
+                recorders.append(h.trace)
+            if getattr(h, "slo", None) is not None:
+                ledgers.append(h.slo)
+    bundle = obs_slo.build_bundle(
+        policy,
+        burn,
+        ledgers,
+        recorders=recorders,
+        timeseries=ts,
+        quorum=f + 1,
+        extra_docs=[gen.sched_doc()],
+    )
+    path = spool.maybe_dump(bundle)
+    report["slo_breach_bundle"] = path
+    report["slo_breach_suppressed"] = spool.suppressed

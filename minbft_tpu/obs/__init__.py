@@ -33,7 +33,12 @@ instrumentation is leveled logging):
   (perf/UTILIZATION.md);
 - :mod:`~minbft_tpu.obs.runinfo` — per-incarnation ``RUN_ID`` and the
   ``minbft_build_info`` attribution block every dump and exposition
-  carries.
+  carries;
+- :mod:`~minbft_tpu.obs.slo` — the latency-SLO engine: per-request
+  finality budgets classified at commit-quorum time, multi-window
+  error-budget burn rates over the telemetry rings, critpath breach
+  attribution, and the breach-triggered forensic auto-dump
+  (perf/SLO.md).
 
 Nothing in this package is reachable from jitted code (enforced by the
 ``tools/analyze`` trace-purity pass), and with tracing disabled the
@@ -48,6 +53,16 @@ from .prom import (
     collect_replica,
     render_families,
     scrape,
+)
+from .slo import (
+    BreachSpool,
+    BudgetLedger,
+    SLOPolicy,
+    breach_report,
+    build_bundle,
+    burn_rates,
+    register_slo_series,
+    slo_enabled,
 )
 from .timeseries import (
     CounterSampler,
@@ -71,6 +86,8 @@ from .trace import (
 __all__ = [
     "CLIENT_STAGES",
     "REPLICA_STAGES",
+    "BreachSpool",
+    "BudgetLedger",
     "CounterSampler",
     "Decomposition",
     "DeviceLedger",
@@ -80,16 +97,22 @@ __all__ = [
     "MTStageRing",
     "MetricsServer",
     "QueueWindow",
+    "SLOPolicy",
     "StageRing",
     "TimeSeries",
+    "breach_report",
+    "build_bundle",
+    "burn_rates",
     "collect_faultnet",
     "collect_replica",
     "dump_recorder",
     "dump_timeseries",
     "load_dumps",
     "merge_timeseries_docs",
+    "register_slo_series",
     "render_families",
     "scrape",
+    "slo_enabled",
     "stage_table",
     "tracing_enabled",
 ]

@@ -120,6 +120,8 @@ def collect_replica(
     timeseries=None,
     groups: Optional[int] = None,
     stall_after_s: float = 30.0,
+    slo=None,
+    slo_spool=None,
 ) -> List[Family]:
     """Build the metric families for one replica process.
 
@@ -311,6 +313,90 @@ def collect_replica(
         )
     if engine is not None:
         fams.extend(_collect_engine(engine, base))
+    if slo is not None:
+        # ``slo`` is the replica's obs.slo.BudgetLedger; burn rates read
+        # the same rings the minbft_window_* gauges render.
+        fams.extend(
+            collect_slo(
+                [slo], timeseries=timeseries, spool=slo_spool, base=base
+            )
+        )
+    return fams
+
+
+def collect_slo(ledgers, timeseries=None, spool=None,
+                base: Optional[Dict[str, str]] = None,
+                now: Optional[float] = None) -> List[Family]:
+    """Families for the latency-SLO engine (obs/slo.py): per-group
+    good/breached counters, the policy knobs, remaining error-budget
+    fraction, the fast/slow burn rates (read from the telemetry rings —
+    omitted when no ring is attached), and the breach-dump spool
+    counters.  A stale group stops committing, its good counter stops
+    moving, and its windowed breach fraction reads budget burn — the
+    per-group labels are what make that legible."""
+    from . import slo as obs_slo
+
+    base = dict(base or {})
+    ledgers = [lg for lg in ledgers if lg is not None]
+    if not ledgers:
+        return []
+
+    def lb(lg) -> Dict[str, str]:
+        if lg.group is None or "group" in base:
+            return base
+        return {**base, "group": str(lg.group)}
+
+    fams: List[Family] = [
+        ("minbft_slo_good_total", "counter",
+         "requests that committed inside the finality budget "
+         "(recv-origin, classified at commit quorum)",
+         [(lb(lg), lg.good) for lg in ledgers]),
+        ("minbft_slo_breached_total", "counter",
+         "requests that committed past the finality budget",
+         [(lb(lg), lg.breached) for lg in ledgers]),
+        ("minbft_slo_target_ms", "gauge",
+         "finality budget per request (SLOPolicy.target_ms)",
+         [(lb(lg), lg.policy.target_ms) for lg in ledgers]),
+        ("minbft_slo_objective", "gauge",
+         "fraction of requests that must meet the budget",
+         [(lb(lg), lg.policy.objective) for lg in ledgers]),
+        ("minbft_slo_budget_remaining", "gauge",
+         "remaining error-budget fraction this incarnation (1 = "
+         "untouched, negative = overspent — not clamped)",
+         [(lb(lg), round(lg.budget_remaining(), 4)) for lg in ledgers]),
+        ("minbft_slo_burn_threshold", "gauge",
+         "fast-window burn multiple that trips breach forensics and "
+         "the `peer top` BREACH flag",
+         [(lb(lg), lg.policy.burn_threshold) for lg in ledgers]),
+    ]
+    if timeseries is not None:
+        burn_samples = []
+        for lg in ledgers:
+            b = obs_slo.burn_rates(
+                timeseries, lg.policy, now=now, group=lg.group
+            )
+            for window in ("fast", "slow"):
+                burn_samples.append(
+                    ({**lb(lg), "window": window}, b[f"{window}_burn"])
+                )
+        fams.append(
+            ("minbft_slo_burn_rate", "gauge",
+             "error-budget burn multiple over the window (1.0 spends "
+             "the budget exactly as fast as the objective allows)",
+             burn_samples)
+        )
+    if spool is not None:
+        fams.append(
+            ("minbft_slo_breach_dumps_total", "counter",
+             "breach forensic bundles written to the spool",
+             [(base, spool.written)])
+        )
+        fams.append(
+            ("minbft_slo_breach_dumps_suppressed_total", "counter",
+             "breach dumps refused by the token bucket or the spool "
+             "bound (a signal of sustained breach, not an error)",
+             [(base, spool.suppressed)])
+        )
     return fams
 
 
@@ -381,7 +467,8 @@ def collect_engine_pool(pool, base: Optional[Dict[str, str]] = None
 
 
 def collect_group_runtime(runtime, engine=None, replica_id=None,
-                          timeseries=None, engine_pool=None) -> List[Family]:
+                          timeseries=None, engine_pool=None,
+                          slo_spool=None) -> List[Family]:
     """Families for a :class:`minbft_tpu.groups.GroupRuntime`: one
     ``collect_replica`` per group core (every series carries its
     ``group`` label), the shared engine's families once (its queues
@@ -406,6 +493,21 @@ def collect_group_runtime(runtime, engine=None, replica_id=None,
     if timeseries is not None:
         lists.append(
             collect_replica(timeseries=timeseries, replica_id=replica_id)
+        )
+    # One collect_slo across every core's ledger: the per-group burn
+    # rates all read the ONE process-level ring (series are per-group
+    # suffixed), and the spool counters are process-level.
+    slo_ledgers = [
+        core.handlers.slo for core in runtime.cores
+        if getattr(core.handlers, "slo", None) is not None
+    ]
+    if slo_ledgers:
+        base = {} if replica_id is None else {"replica": str(replica_id)}
+        lists.append(
+            collect_slo(
+                slo_ledgers, timeseries=timeseries, spool=slo_spool,
+                base=base,
+            )
         )
     fams = merge_family_lists(lists)
     if engine_pool is None:

@@ -40,6 +40,8 @@ class SimpleConfiger(api.Configer):
         peers: Optional[List[PeerAddr]] = None,
         batchsize_prepare: int = 64,
         groups: int = 1,
+        slo_target_ms: Optional[float] = None,
+        slo_objective: Optional[float] = None,
     ):
         self._n = n
         self._f = f
@@ -58,6 +60,14 @@ class SimpleConfiger(api.Configer):
         # identical cluster-wide, so it lives in the shared file —
         # CONSENSUS_GROUPS exists for test/bench layering only.
         self.groups = groups
+        # Latency-SLO policy (obs/slo.py): finality budget + objective
+        # fraction.  None = SLO accounting stays off unless the
+        # MINBFT_SLO_* env knobs turn it on; a set target here enables
+        # it (consensus.yaml ``protocol.slo.{target,objective}``).  The
+        # MINBFT_SLO_* env always layers on top, including per-group
+        # comma lists.
+        self.slo_target_ms = slo_target_ms
+        self.slo_objective = slo_objective
 
     @property
     def n(self) -> int:
@@ -99,6 +109,7 @@ def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfig
     data = _parse_yaml(text)
     proto = data.get("protocol", {})
     timeout = proto.get("timeout", {})
+    slo = proto.get("slo", {})
     peers = [
         PeerAddr(id=int(p["id"]), addr=str(p["addr"]))
         for p in data.get("peers", [])
@@ -129,6 +140,18 @@ def load_config(path: str, env: Optional[Dict[str, str]] = None) -> SimpleConfig
             "BATCHSIZE_PREPARE", proto.get("batchsizePrepare", 64), int
         ),
         groups=layered("GROUPS", proto.get("groups", 1), int),
+        # `protocol.slo.target: 1s` / `.objective: 0.99`; absent keys
+        # stay None so the SLO engine's env-gated default is untouched.
+        slo_target_ms=(
+            layered("SLO_TARGET", slo.get("target", "1s"), _seconds) * 1e3
+            if "target" in slo or env.get("CONSENSUS_SLO_TARGET")
+            else None
+        ),
+        slo_objective=(
+            layered("SLO_OBJECTIVE", slo.get("objective", 0.99), float)
+            if "objective" in slo or env.get("CONSENSUS_SLO_OBJECTIVE")
+            else None
+        ),
     )
 
 

@@ -292,8 +292,37 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
     )
     tp.add_argument(
         "--stall-flag", action="store_true",
-        help="exit 3 when any replica reports a commit stall or stale "
-        "group (alerting hook for scripts)",
+        help="exit 3 when any replica reports a commit stall, a stale "
+        "group, or a fast-window SLO burn at/over its threshold "
+        "(alerting hook for scripts)",
+    )
+
+    sl = sub.add_parser(
+        "slo",
+        help="one-shot latency-SLO report from replica --metrics-port "
+        "endpoints: per-group good/breached counts, remaining error "
+        "budget, fast/slow burn rates, and breach-dump spool counters "
+        "(perf/SLO.md); --dumps additionally reads a trace-dump file "
+        "set and prints the per-segment breach attribution",
+    )
+    sl.add_argument(
+        "addr", nargs="+",
+        help="host:port (or full URL) of each replica's metrics endpoint",
+    )
+    sl.add_argument("--timeout", type=float, default=5.0)
+    sl.add_argument(
+        "--json", action="store_true",
+        help="machine-readable JSON instead of the table",
+    )
+    sl.add_argument(
+        "--dumps", default="",
+        help="MINBFT_TRACE_DUMP base path: load {base}.*.json and "
+        "append the breach attribution (policy from MINBFT_SLO_* env)",
+    )
+    sl.add_argument(
+        "--breach-flag", action="store_true",
+        help="exit 3 when any group's fast-window burn is at/over its "
+        "threshold (alerting hook for scripts)",
     )
 
     q = sub.add_parser("request", help="submit request(s) as a client")
@@ -403,6 +432,13 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
     ld.add_argument(
         "--drain", type=float, default=10.0,
         help="seconds past the last arrival to wait for stragglers",
+    )
+    ld.add_argument(
+        "--slo-target-ms", type=float, default=0.0,
+        help="finality-SLO bar (perf/SLO.md): rc=1 unless the fraction "
+        "of fired requests committing inside this budget reaches the "
+        "objective (MINBFT_SLO_OBJECTIVE, default 0.99); 0 (default) = "
+        "no SLO leg in the rc contract",
     )
 
     st = sub.add_parser("selftest", help="in-process n=4 cluster smoke test")
@@ -598,6 +634,21 @@ async def _run_replica(args) -> int:
     if engine is not None and os.environ.get(obs_trace.TRACE_DUMP_ENV):
         engine.enable_obs_ring()
 
+    # Latency-SLO engine (obs/slo.py): the Handlers built their own
+    # BudgetLedger when the policy is enabled (MINBFT_SLO_* env or the
+    # config's protocol.slo block) — gather them once for the sampler,
+    # the Prometheus families, and the breach-forensics watch below.
+    from ...obs import slo as obs_slo
+
+    _handler_list = (
+        [c.handlers for c in replica.cores] if grouped
+        else [replica.handlers]
+    )
+    slo_ledgers = [
+        h.slo for h in _handler_list if getattr(h, "slo", None) is not None
+    ]
+    slo_spool = obs_slo.BreachSpool.from_env() if slo_ledgers else None
+
     # Telemetry rings (obs/timeseries.py): sampled whenever anyone can
     # read them — the Prometheus endpoint (minbft_window_* gauges feed
     # `peer top --once`) or the trace-dump surface ({base}.rN.ts.json).
@@ -622,6 +673,11 @@ async def _run_replica(args) -> int:
         elif engine_pool is not None:
             # the pool exposes the same merged stats/depth surfaces
             obs_ts.register_engine_series(sampler, engine_pool)
+        for lg in slo_ledgers:
+            # good/breached counter deltas into the same ring: the
+            # minbft_slo_burn_rate gauges and `peer top`'s BURN column
+            # read their windows, and cross-process merges stay exact
+            obs_slo.register_slo_series(sampler, lg)
 
     metrics_server = None
     if args.metrics_port >= 0:
@@ -642,6 +698,7 @@ async def _run_replica(args) -> int:
                         engine=engine if engine is not None else engine_pool,
                         replica_id=args.id,
                         timeseries=tseries,
+                        slo_spool=slo_spool,
                     )
                 )
 
@@ -654,6 +711,8 @@ async def _run_replica(args) -> int:
                         engine=engine,
                         replica_id=args.id,
                         timeseries=tseries,
+                        slo=slo_ledgers[0] if slo_ledgers else None,
+                        slo_spool=slo_spool,
                     )
                 )
 
@@ -742,9 +801,42 @@ async def _run_replica(args) -> int:
         loop.create_task(sampler.run()) if sampler is not None else None
     )
 
+    # Breach-forensics watch (obs/slo.py): one task per policy group
+    # reads the ring's fast-window burn every second; crossing the
+    # threshold hands the spool a lazy bundle (built only if the token
+    # bucket and the spool bound both allow).  Needs the sampler — burn
+    # is a ring reading, and without ticks the window is always empty.
+    slo_watch_tasks = []
+    if slo_spool is not None and sampler is not None:
+        _slo_recorders = [
+            h.trace for h in _handler_list
+            if getattr(h, "trace", None) is not None
+        ]
+
+        def _slo_bundle(burn: dict) -> dict:
+            return obs_slo.build_bundle(
+                slo_ledgers[0].policy,
+                burn,
+                slo_ledgers,
+                recorders=_slo_recorders,
+                timeseries=tseries,
+            )
+
+        for lg in slo_ledgers:
+            slo_watch_tasks.append(loop.create_task(obs_slo.watch(
+                tseries, lg.policy, slo_spool, _slo_bundle, group=lg.group
+            )))
+
     async def stop_sampler() -> None:
         # Cancel-and-await: the sampler's CancelledError handler flushes
         # the final partial interval before the ring is dumped/rendered.
+        for t in slo_watch_tasks:
+            t.cancel()
+        for t in slo_watch_tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
         if sampler_task is not None:
             sampler_task.cancel()
             try:
@@ -1006,7 +1098,8 @@ async def _run_load(args) -> int:
 
     rc contract (the CI load-smoke step's interface): 0 = schedule fired
     faithfully (live census == seed replay) and any --expect-goodput bar
-    was met; 1 otherwise.  Progress notes go to stderr."""
+    was met and any --slo-target-ms bar was met; 1 otherwise.  Progress
+    notes go to stderr."""
     import json as _json
 
     from ...loadgen import LoadSpec
@@ -1041,9 +1134,14 @@ async def _run_load(args) -> int:
         drain_s=args.drain,
         expect_goodput=args.expect_goodput,
         scheme=args.scheme,
+        slo_target_ms=args.slo_target_ms if args.slo_target_ms > 0 else None,
     )
     print(_json.dumps(report), flush=True)
-    ok = report["census_ok"] and report.get("goodput_ok", True)
+    ok = (
+        report["census_ok"]
+        and report.get("goodput_ok", True)
+        and report.get("slo_ok", True)
+    )
     if not report["census_ok"]:
         print("load: FAILED — generator diverged from the seeded "
               "schedule (census mismatch)", file=sys.stderr)
@@ -1051,6 +1149,14 @@ async def _run_load(args) -> int:
         print(
             f"load: FAILED — goodput {report['goodput_per_sec']}/s below "
             f"the --expect-goodput {args.expect_goodput}/s bar",
+            file=sys.stderr,
+        )
+    if not report.get("slo_ok", True):
+        print(
+            f"load: FAILED — slo_good_fraction "
+            f"{report['slo_good_fraction']} below the "
+            f"{report['slo_objective']} objective for the "
+            f"{args.slo_target_ms}ms finality budget",
             file=sys.stderr,
         )
     # The report is out; a leaked replica task wedging interpreter
@@ -1412,6 +1518,18 @@ def _scrape_top_state(addr: str, timeout: float) -> dict:
             chips.setdefault(ident, {})[field] = v
     state["chips"] = chips
     state["home_chip"] = by_identity("minbft_engine_pool_home_chip")
+    # SLO families (obs/slo.py): absent when the target runs without a
+    # policy — the console renders "-" columns, never crashes.
+    state["slo_budget"] = by_identity("minbft_slo_budget_remaining")
+    state["slo_threshold"] = by_identity("minbft_slo_burn_threshold")
+    burn: dict = {}
+    for key, v in samples("minbft_slo_burn_rate").items():
+        lb = dict(key)
+        burn[(
+            lb.get("replica", "?"), lb.get("group", "-"),
+            lb.get("window", "fast"),
+        )] = v
+    state["slo_burn"] = burn
     for name, fam in fams.items():
         if name.startswith("minbft_window_"):
             state["window"][name[len("minbft_window_"):]] = next(
@@ -1428,7 +1546,7 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
     lines = [
         f"{'TARGET':<24}{'R':>3}{'G':>3}{'REQ/S':>9}{'SHED/S':>8}"
         f"{'FILL':>7}{'UTIL%':>7}{'DEPTH':>7}{'PEAK':>6}{'LAG_MS':>8}"
-        f"{'VIEW':>5}  HEALTH"
+        f"{'BURN':>6}{'BUDG':>6}{'VIEW':>5}  HEALTH"
     ]
     unhealthy = False
     for addr in sorted(set(states) | set(errors)):
@@ -1503,6 +1621,18 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
             if st["stale"].get(ident):
                 flags.append("STALE")
                 unhealthy = True
+            # SLO columns (perf/SLO.md): fast-window burn multiple and
+            # remaining error budget; crossing the policy's threshold
+            # raises BREACH (and trips --stall-flag like a stall).
+            fast_burn = st.get("slo_burn", {}).get((rid, grp, "fast"))
+            budget = st.get("slo_budget", {}).get(ident)
+            thr = st.get("slo_threshold", {}).get(ident)
+            if (fast_burn is not None and thr is not None and thr > 0
+                    and fast_burn >= thr):
+                flags.append("BREACH")
+                unhealthy = True
+            burn_s = f"{fast_burn:.1f}" if fast_burn is not None else "-"
+            budg_s = f"{budget:.2f}" if budget is not None else "-"
             vc = st["vchanges"].get(ident, 0)
             if vc:
                 flags.append(f"vc={int(vc)}")
@@ -1510,8 +1640,8 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
             lines.append(
                 f"{addr:<24}{rid:>3}{grp:>3}{rps:>9.1f}{shed_rate:>8.1f}"
                 f"{fill:>7.1f}{min(util, 999.0):>7.1f}{st['depth']:>7.0f}"
-                f"{st['peak']:>6.0f}{lag:>8.2f}{view:>5}"
-                f"  {' '.join(flags) or 'ok'}"
+                f"{st['peak']:>6.0f}{lag:>8.2f}{burn_s:>6}{budg_s:>6}"
+                f"{view:>5}  {' '.join(flags) or 'ok'}"
             )
             # Engine-pool expansion (ISSUE 17): the group's home chip as
             # a sub-row.  A chip the scrape knows nothing about (or one
@@ -1574,6 +1704,134 @@ def _run_top(args) -> int:
             return 0
 
 
+def _run_slo(args) -> int:
+    """``peer slo`` — one-shot latency-SLO report (perf/SLO.md).
+
+    Scrapes each target's ``minbft_slo_*`` families and prints one row
+    per (target, group): lifetime good/breached counts, the policy's
+    target/objective, remaining error budget, fast/slow burn multiples,
+    and the breach-dump spool counters.  ``--dumps BASE`` additionally
+    loads a trace-dump file set ({base}.*.json) and appends the
+    per-segment breach attribution.  rc: 0 ok, 1 scrape failure, 3 with
+    ``--breach-flag`` when any fast burn is at/over its threshold."""
+    import json as _json
+
+    from ...obs.prom import parse_exposition, scrape
+
+    rc = 0
+    breach = False
+    report: dict = {"targets": []}
+    for addr in args.addr:
+        try:
+            fams = parse_exposition(scrape(addr, timeout=args.timeout))
+        except OSError as e:
+            print(f"peer: slo scrape of {addr} failed: {e}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+
+        def samples(name: str) -> dict:
+            fam = fams.get(name)
+            return fam["samples"] if fam else {}
+
+        groups: dict = {}
+
+        def fold(name: str, field: str) -> None:
+            for key, v in samples(name).items():
+                lb = dict(key)
+                g = lb.get("group", "-")
+                f = (
+                    f"{field}_{lb['window']}" if "window" in lb else field
+                )
+                groups.setdefault(g, {})[f] = v
+
+        fold("minbft_slo_good_total", "good")
+        fold("minbft_slo_breached_total", "breached")
+        fold("minbft_slo_target_ms", "target_ms")
+        fold("minbft_slo_objective", "objective")
+        fold("minbft_slo_budget_remaining", "budget_remaining")
+        fold("minbft_slo_burn_threshold", "burn_threshold")
+        fold("minbft_slo_burn_rate", "burn")
+        spool = {
+            "written": sum(
+                samples("minbft_slo_breach_dumps_total").values()
+            ),
+            "suppressed": sum(
+                samples(
+                    "minbft_slo_breach_dumps_suppressed_total"
+                ).values()
+            ),
+        }
+        for g in groups.values():
+            total = g.get("good", 0) + g.get("breached", 0)
+            g["good_fraction"] = (
+                round(g.get("good", 0) / total, 4) if total else 1.0
+            )
+            thr = g.get("burn_threshold", 0)
+            if thr > 0 and g.get("burn_fast", 0.0) >= thr:
+                g["breach"] = True
+                breach = True
+        report["targets"].append(
+            {"addr": addr, "groups": groups, "spool": spool}
+        )
+    if args.dumps:
+        from ...obs import slo as obs_slo
+        from ...obs.trace import load_dumps
+
+        docs = load_dumps(args.dumps)
+        report["breach_report"] = obs_slo.breach_report(
+            docs, obs_slo.SLOPolicy.from_env()
+        )
+    if args.json:
+        print(_json.dumps(report, sort_keys=True), flush=True)
+    else:
+        print(
+            f"{'TARGET':<24}{'G':>3}{'GOOD':>9}{'BREACHED':>9}"
+            f"{'GOODFRAC':>9}{'TARGET_MS':>10}{'BUDGET':>8}"
+            f"{'FAST':>7}{'SLOW':>7}  FLAG"
+        )
+        for tgt in report["targets"]:
+            if not tgt["groups"]:
+                print(f"{tgt['addr']:<24}  (no SLO policy — set "
+                      "MINBFT_SLO_TARGET_MS or protocol.slo)")
+                continue
+            for g in sorted(tgt["groups"]):
+                row = tgt["groups"][g]
+                print(
+                    f"{tgt['addr']:<24}{g:>3}"
+                    f"{int(row.get('good', 0)):>9}"
+                    f"{int(row.get('breached', 0)):>9}"
+                    f"{row.get('good_fraction', 1.0):>9.4f}"
+                    f"{row.get('target_ms', 0.0):>10.0f}"
+                    f"{row.get('budget_remaining', 1.0):>8.2f}"
+                    f"{row.get('burn_fast', 0.0):>7.1f}"
+                    f"{row.get('burn_slow', 0.0):>7.1f}"
+                    f"  {'BREACH' if row.get('breach') else 'ok'}"
+                )
+            if tgt["spool"]["written"] or tgt["spool"]["suppressed"]:
+                print(
+                    f"{'':<24} └ breach dumps: "
+                    f"{int(tgt['spool']['written'])} written, "
+                    f"{int(tgt['spool']['suppressed'])} suppressed"
+                )
+        br = report.get("breach_report")
+        if br:
+            print(
+                f"breach attribution ({br['origin']}-origin, "
+                f"{br['breached']}/{br['requests']} breached, "
+                f"{br['breached_spend_ms']}ms spent):"
+            )
+            for seg, ms in sorted(
+                br["attribution_ms"].items(), key=lambda kv: -kv[1]
+            ):
+                print(f"  {seg:<16}{ms:>12.3f} ms")
+    if rc:
+        return rc
+    if args.breach_flag and breach:
+        return 3
+    return 0
+
+
 def main(argv=None) -> int:
     path, explicit = peek_options_path(argv)
     args = build_parser(load_peer_options(path, explicit)).parse_args(argv)
@@ -1589,6 +1847,8 @@ def main(argv=None) -> int:
         return _run_metrics_scrape(args)
     if args.command == "top":
         return _run_top(args)
+    if args.command == "slo":
+        return _run_slo(args)
     if args.command == "request":
         return asyncio.run(_run_request(args))
     if args.command == "bench":

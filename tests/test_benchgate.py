@@ -318,3 +318,97 @@ def test_grid_keys_respect_backend_refusal():
     )
     with pytest.raises(BackendMismatch):
         benchgate.compare(tpu_base, cpu_cand)
+
+
+def test_load_finality_p99_is_gated_on_increase():
+    """ISSUE 19: the SLO finality headline (load_*_finality_p99_ms —
+    scheduled-origin, unresolved requests charged their age) gates on
+    INCREASE with the same wide latency floor as the plain p99."""
+    base = _artifact(100.0, load_sat_finality_p99_ms=2000.0)
+    worse = _artifact(100.0, load_sat_finality_p99_ms=7000.0)  # 3.5x
+    report = benchgate.compare(base, worse)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["load_sat_finality_p99"].status == "regression"
+    assert by_key["load_sat_finality_p99"].direction == "increase"
+    assert by_key["load_sat_finality_p99"].drop == pytest.approx(5000.0)
+    # 2x sits inside the default 1.5x-increase floor: tolerated
+    assert benchgate.compare(
+        base, _artifact(100.0, load_sat_finality_p99_ms=4000.0)
+    ).ok
+    assert {r.key: r.status for r in benchgate.compare(
+        base, _artifact(100.0, load_sat_finality_p99_ms=500.0)
+    ).results}["load_sat_finality_p99"] == "improved"
+
+
+def test_cli_injected_finality_regression_exits_1(tmp_path, capsys):
+    """Gate liveness for the new family: a 3x finality-p99 wedge at one
+    curve point must flip the CLI to rc 1 even when every classic
+    throughput key holds."""
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(_artifact(
+        100.0, load_over_finality_p99_ms=3000.0
+    )))
+    cand_p.write_text(json.dumps(_artifact(
+        100.0, load_over_finality_p99_ms=9000.0  # 3x > the 1.5x floor
+    )))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 1
+    assert "load_over_finality_p99" in capsys.readouterr().out
+
+
+def test_grid_finality_joins_the_gate():
+    """The (G, chips) grid's embedded finality keys
+    (groups{G}x{C}_load_*_finality_p99_ms) ride the same increase rule
+    as the top-level curve."""
+    base = _artifact(100.0, groups4x2_load_over_finality_p99_ms=1000.0)
+    cand = dict(base)
+    cand["groups4x2_load_over_finality_p99_ms"] = 9000.0
+    report = benchgate.compare(base, cand)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["groups4x2_load_over_finality_p99"].status == "regression"
+    assert by_key["groups4x2_load_over_finality_p99"].direction == "increase"
+
+
+def test_slo_family_respects_load_namespace_and_fraction_stays_ungated():
+    """Namespace pin for the slo family: a finality lookalike outside
+    the load_/groups{G}x{C}_load_ namespaces never joins the gate, and
+    the informational slo_good_fraction companion is not gated at all
+    (the finality p99 is the gated half of the pair)."""
+    base = _artifact(
+        100.0,
+        sched_finality_p99_ms=5.0,  # not in a load namespace
+        groups8_finality_p99_ms=5.0,  # sweep key, not a grid curve
+        load_sat_slo_good_fraction=0.999,
+        load_sat_finality_p99_ms=800.0,
+    )
+    cand = dict(base)
+    cand["sched_finality_p99_ms"] = 500.0
+    cand["groups8_finality_p99_ms"] = 500.0
+    cand["load_sat_slo_good_fraction"] = 0.1  # collapse: informational
+    report = benchgate.compare(base, cand)
+    assert [r.key for r in report.results] == [
+        "e2e", "load_sat_finality_p99"
+    ]
+    assert report.ok
+
+
+def test_finality_keys_respect_backend_refusal(tmp_path):
+    """Cross-backend refusal covers the new family: a CPU candidate
+    carrying finality keys never gates against a chip baseline — the
+    CLI refuses with rc 2 before reading a number."""
+    tpu_base = _artifact(
+        1000.0, backend="tpu", tpu_unavailable=False,
+        load_sat_finality_p99_ms=40.0,
+    )
+    cpu_cand = _artifact(5.0, load_sat_finality_p99_ms=4000.0)
+    with pytest.raises(BackendMismatch):
+        benchgate.compare(tpu_base, cpu_cand)
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(tpu_base))
+    cand_p.write_text(json.dumps(cpu_cand))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 2

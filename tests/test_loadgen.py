@@ -561,3 +561,109 @@ def test_no_pycache_only_orphan_dirs():
         f"__pycache__-only orphan dirs: {offenders} — delete them or "
         "restore their packages"
     )
+
+
+# ---------------------------------------------------------------------------
+# SLO surface (ISSUE 19): finality percentiles, good fraction, and the
+# breach-forensics hook on the runner.
+
+
+def test_report_carries_slo_surface():
+    """Every report carries the SLO pair: scheduled-origin finality p99
+    (unresolved requests charged their age-so-far) and the fraction of
+    FIRED requests inside the budget.  A generous budget clears
+    everything; a sub-microsecond budget clears nothing — same run,
+    same latencies, only the policy line moves."""
+
+    async def run(target_ms):
+        spec = LoadSpec(seed=0x510, rate=100.0, duration_s=0.8, n_clients=20)
+        _store, auths = _mac_fleet(1, 20)
+        gen = OpenLoopGenerator(
+            spec, 1, 0, list(range(20)), auths, [_InstantEchoConn()],
+            retransmit_interval=None, drain_s=_t(10),
+            slo_target_ms=target_ms,
+        )
+        rep = await gen.run()
+        return rep, gen
+
+    rep, gen = asyncio.run(run(60_000.0))
+    assert rep["census_ok"] and rep["timeouts"] == 0
+    assert rep["slo_target_ms"] == 60_000.0
+    assert rep["slo_good_fraction"] == 1.0
+    assert rep["finality_p99_ms"] > 0
+    # all resolved: finality p99 IS the scheduled-origin p99
+    assert rep["finality_p99_ms"] == pytest.approx(rep["p99_ms"], rel=1e-6)
+
+    # the same harness under an unmeetable budget: zero good
+    rep2, gen2 = asyncio.run(run(1e-6))
+    assert rep2["census_ok"]
+    assert rep2["slo_good_fraction"] == 0.0
+
+    # sched_doc feeds breach attribution: one scheduled-origin latency
+    # per RESOLVED request, keyed cid:seq
+    doc = gen.sched_doc()
+    assert doc["kind"] == "loadgen"
+    assert len(doc["sched_lat_ns"]) == rep["resolved"]
+    assert all(ns > 0 for ns in doc["sched_lat_ns"].values())
+
+    # slo_ring replays the classifications into a mergeable wall-clock
+    # ring: totals match the report's counts
+    from minbft_tpu.obs.slo import SLOPolicy, burn_rates
+
+    ring = gen2.slo_ring()
+    b = burn_rates(
+        ring, SLOPolicy(target_ms=1e-6), now=time.time() + 2.0,
+        group=None,
+    )
+    # every request breached: the slow window must show pure breach
+    assert b["slow_breached_per_sec"] > 0
+    assert b["slow_good_per_sec"] == 0.0
+
+
+def test_run_local_load_slo_contract_and_breach_forensics(
+    tmp_path, monkeypatch
+):
+    """The runner's rc contract surface: slo_ok = good_fraction >=
+    objective.  With a breach-forensics spool configured and an
+    unmeetable budget, exactly ONE bounded bundle lands in the spool
+    (token bucket + spool bound), stamped kind=slo_breach, with its
+    attribution summing to the breached spend when trace docs exist."""
+    from minbft_tpu.loadgen.runner import run_local_load
+
+    monkeypatch.setenv("MINBFT_TRACE", "1")
+    monkeypatch.setenv("MINBFT_SLO_DUMP", str(tmp_path))
+    spec = LoadSpec(seed=0x510E, rate=120.0, duration_s=1.0, n_clients=60)
+    rep = asyncio.run(
+        run_local_load(spec, drain_s=_t(15), slo_target_ms=1e-6)
+    )
+    assert rep["census_ok"]
+    assert rep["slo_good_fraction"] == 0.0
+    assert rep["slo_ok"] is False
+    assert 0 < rep["slo_objective"] <= 1.0
+
+    bundles = sorted(tmp_path.glob("slo_breach.*.json"))
+    assert len(bundles) == 1, bundles  # rate-limited: exactly one
+    assert rep["slo_breach_bundle"] == str(bundles[0])
+    import json
+
+    doc = json.load(open(bundles[0]))
+    assert doc["kind"] == "slo_breach"
+    assert doc["policy"]["target_ms"] == 1e-6
+    breach = doc["breach"]
+    assert breach["origin"] == "scheduled"
+    assert breach["breached"] > 0
+    assert sum(breach["attribution_ms"].values()) == pytest.approx(
+        breach["breached_spend_ms"], abs=0.01
+    )
+    assert "sched_wait" in breach["attribution_ms"]
+    assert doc["ledgers"], doc.keys()  # per-core counters rode along
+
+    # a meetable budget on the same harness reports slo_ok True and
+    # never touches the spool again (good_fraction >= objective)
+    spec2 = LoadSpec(seed=0x510F, rate=80.0, duration_s=0.8, n_clients=40)
+    rep2 = asyncio.run(
+        run_local_load(spec2, drain_s=_t(15), slo_target_ms=60_000.0)
+    )
+    assert rep2["slo_ok"] is True and rep2["slo_good_fraction"] == 1.0
+    assert "slo_breach_bundle" not in rep2
+    assert len(sorted(tmp_path.glob("slo_breach.*.json"))) == 1

@@ -892,3 +892,113 @@ def test_peer_top_once_renders_live_grouped_cluster(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def test_peer_slo_scrapes_live_cluster_with_slo_families(tmp_path):
+    """Acceptance (ISSUE 19): with MINBFT_SLO_TARGET_MS set, a real
+    `peer run --metrics-port` cluster exposes the minbft_slo_* families
+    on /metrics, `peer top --once` renders the BURN/BUDG columns, and
+    the one-shot `peer slo` report folds the scrape into per-group
+    rows (rc 0; --breach-flag stays 0 on a healthy cluster)."""
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env["MINBFT_SLO_TARGET_MS"] = "60000"
+    d = str(tmp_path)
+    base_port = _free_base_port(4)
+
+    scaffold = subprocess.run(
+        [sys.executable, "-m", "minbft_tpu.sample.peer", "testnet",
+         "-n", "4", "-d", d, "--base-port", str(base_port),
+         "--usig", "SOFT_ECDSA"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert scaffold.returncode == 0, scaffold.stderr
+
+    replicas = []
+    logs = []
+    try:
+        for i in range(4):
+            log = open(f"{d}/replica{i}.log", "wb")
+            logs.append(log)
+            replicas.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "minbft_tpu.sample.peer",
+                     "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+                     "run", str(i), "--no-batch", "--metrics-port", "0"],
+                    env=env, stdout=subprocess.DEVNULL, stderr=log,
+                )
+            )
+        assert _wait_ports([base_port + i for i in range(4)]), "never bound"
+        mports = [_metrics_port(f"{d}/replica{i}.log") for i in range(4)]
+
+        req = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
+             "request", "slo-op", "--timeout", "120"],
+            env=env, capture_output=True, text=True, timeout=180,
+        )
+        assert req.returncode == 0, req.stderr
+
+        import urllib.request
+
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{mports[0]}/metrics", timeout=10
+        ).read().decode()
+        for family in (
+            "minbft_slo_good_total",
+            "minbft_slo_breached_total",
+            "minbft_slo_target_ms",
+            "minbft_slo_objective",
+            "minbft_slo_budget_remaining",
+            "minbft_slo_burn_threshold",
+            "minbft_slo_burn_rate",
+        ):
+            assert family in text, family
+        assert 'window="fast"' in text and 'window="slow"' in text
+
+        addrs = [f"127.0.0.1:{p}" for p in mports]
+        top = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "top", "--once", "--stall-flag", *addrs],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert top.returncode == 0, top.stderr + top.stdout
+        assert "BURN" in top.stdout and "BUDG" in top.stdout
+
+        slo = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "slo", "--json", "--breach-flag", *addrs],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert slo.returncode == 0, slo.stderr + slo.stdout
+        report = json.loads(slo.stdout)
+        assert len(report["targets"]) == 4
+        committed_somewhere = 0
+        for tgt in report["targets"]:
+            row = tgt["groups"]["-"]  # ungrouped: one identity row
+            assert row["target_ms"] == 60000.0
+            assert 0 < row["objective"] <= 1.0
+            assert row["good_fraction"] == 1.0  # 60s budget: all good
+            assert not row.get("breach")
+            committed_somewhere += row.get("good", 0)
+            assert tgt["spool"] == {"written": 0, "suppressed": 0}
+        assert committed_somewhere >= 1  # the committed op was classed
+
+        # the human rendering of the same report
+        table = subprocess.run(
+            [sys.executable, "-m", "minbft_tpu.sample.peer",
+             "slo", addrs[0]],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert table.returncode == 0, table.stderr + table.stdout
+        assert "GOODFRAC" in table.stdout and "TARGET_MS" in table.stdout
+    finally:
+        for p in replicas:
+            if p.poll() is None:
+                p.terminate()
+        for p in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

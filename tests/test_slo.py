@@ -1,0 +1,474 @@
+"""Latency-SLO engine tests (minbft_tpu/obs/slo.py, ISSUE 19): policy
+env layering (per-group comma lists), ledger classification semantics,
+hand-computed multi-window burn rates and their exact cross-process
+merge, the breach-attribution invariant (segments sum to the breached
+requests' budget spend, under every classification origin), and the two
+forensics defenses (token bucket + spool bound) under sustained breach.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from minbft_tpu.obs import critpath
+from minbft_tpu.obs import slo
+from minbft_tpu.obs.slo import (
+    BreachSpool,
+    BudgetLedger,
+    SLOPolicy,
+    TokenBucket,
+    breach_report,
+    burn_rates,
+    register_slo_series,
+    series_name,
+)
+from minbft_tpu.obs.timeseries import CounterSampler, TimeSeries
+
+from test_critpath import MS, synth_docs
+
+
+# ---------------------------------------------------------------------------
+# policy / env layering
+
+
+def test_policy_defaults():
+    p = SLOPolicy()
+    assert p.target_ms == 1000.0 and p.objective == 0.99
+    assert p.budget_ns == 1e9
+    assert p.error_budget == pytest.approx(0.01)
+    assert p.fast_window_s == 5.0 and p.slow_window_s == 60.0
+    assert p.burn_threshold == 8.0
+
+
+def test_policy_objective_100_percent_never_divides_by_zero():
+    assert SLOPolicy(objective=1.0).error_budget > 0
+
+
+def test_policy_env_overrides(monkeypatch):
+    monkeypatch.setenv(slo.TARGET_ENV, "250")
+    monkeypatch.setenv(slo.OBJECTIVE_ENV, "0.999")
+    monkeypatch.setenv(slo.FAST_WINDOW_ENV, "2")
+    monkeypatch.setenv(slo.SLOW_WINDOW_ENV, "30")
+    monkeypatch.setenv(slo.BURN_THRESHOLD_ENV, "4")
+    p = SLOPolicy.from_env()
+    assert p.target_ms == 250.0 and p.objective == 0.999
+    assert p.fast_window_s == 2.0 and p.slow_window_s == 30.0
+    assert p.burn_threshold == 4.0
+
+
+def test_policy_per_group_comma_list(monkeypatch):
+    """"1000,500" gives group 0 the first entry; a SHORT list extends
+    its last entry to every later group (adding a group never silently
+    drops SLO coverage), and a garbage entry falls back to the
+    configer/default layer instead of erroring."""
+    monkeypatch.setenv(slo.TARGET_ENV, "1000,500")
+    assert SLOPolicy.from_env(group=0).target_ms == 1000.0
+    assert SLOPolicy.from_env(group=1).target_ms == 500.0
+    assert SLOPolicy.from_env(group=7).target_ms == 500.0  # extends last
+    assert SLOPolicy.from_env().target_ms == 1000.0  # ungrouped: first
+    monkeypatch.setenv(slo.TARGET_ENV, "bogus")
+    assert SLOPolicy.from_env(group=0).target_ms == 1000.0
+
+
+def test_policy_configer_layering(monkeypatch):
+    """consensus.yaml fields arrive via the configer; env goes on top —
+    the same layering every other protocol knob uses."""
+
+    class Cfg:
+        slo_target_ms = 750.0
+        slo_objective = 0.95
+
+    monkeypatch.delenv(slo.TARGET_ENV, raising=False)
+    monkeypatch.delenv(slo.OBJECTIVE_ENV, raising=False)
+    p = SLOPolicy.from_env(configer=Cfg())
+    assert p.target_ms == 750.0 and p.objective == 0.95
+    monkeypatch.setenv(slo.TARGET_ENV, "200")
+    assert SLOPolicy.from_env(configer=Cfg()).target_ms == 200.0
+
+
+def test_slo_enabled_gates(monkeypatch):
+    for var in (slo.SLO_ENV, slo.DUMP_ENV, slo.TARGET_ENV):
+        monkeypatch.delenv(var, raising=False)
+    assert not slo.slo_enabled()
+    monkeypatch.setenv(slo.SLO_ENV, "1")
+    assert slo.slo_enabled()
+    monkeypatch.setenv(slo.SLO_ENV, "0")  # explicit off, repo convention
+    assert not slo.slo_enabled()
+    monkeypatch.delenv(slo.SLO_ENV)
+    monkeypatch.setenv(slo.DUMP_ENV, "/tmp/spool")
+    assert slo.slo_enabled()
+    monkeypatch.delenv(slo.DUMP_ENV)
+    monkeypatch.setenv(slo.TARGET_ENV, "100")
+    assert slo.slo_enabled()
+    monkeypatch.delenv(slo.TARGET_ENV)
+
+    class Cfg:
+        slo_target_ms = 500.0
+
+    assert slo.slo_enabled(Cfg())
+
+
+# ---------------------------------------------------------------------------
+# ledger classification
+
+
+def test_ledger_classifies_good_and_breached():
+    fast = BudgetLedger(SLOPolicy(target_ms=1e6))  # ~17 min budget
+    fast.arrive(1, 1)
+    assert fast.commit(1, 1) is True
+    assert (fast.good, fast.breached) == (1, 0)
+    assert fast.good_fraction() == 1.0
+
+    tight = BudgetLedger(SLOPolicy(target_ms=0.0))  # nothing can meet it
+    tight.arrive(1, 2)
+    assert tight.commit(1, 2) is False
+    assert (tight.good, tight.breached) == (0, 1)
+    assert tight.breached_budget_ns > 0  # the spend attribution covers
+
+
+def test_ledger_unknown_commit_is_none_and_retransmit_keeps_stamp():
+    lg = BudgetLedger(SLOPolicy())
+    assert lg.commit(9, 9) is None  # no arrival stamp: unclassifiable
+    assert lg.total == 0
+    lg.arrive(2, 5)
+    t0 = lg._origin[(2, 5)]
+    lg.arrive(2, 5)  # retransmission must NOT reset the clock
+    assert lg._origin[(2, 5)] == t0
+
+
+def test_ledger_inflight_map_is_bounded():
+    lg = BudgetLedger(SLOPolicy())
+    for i in range(slo._MAX_INFLIGHT_KEYS):
+        lg._origin[(0, i)] = 1
+    lg.arrive(1, 0)  # at the bound: wholesale reset, then stamp
+    assert len(lg._origin) == 1 and (1, 0) in lg._origin
+
+
+def test_budget_remaining_math():
+    lg = BudgetLedger(SLOPolicy(objective=0.99))
+    assert lg.budget_remaining() == 1.0  # no traffic: untouched
+    lg.good, lg.breached = 99, 1  # breach rate == allowed rate
+    assert lg.budget_remaining() == pytest.approx(0.0)
+    lg.good, lg.breached = 98, 2  # 2x overspend: negative, unclamped
+    assert lg.budget_remaining() == pytest.approx(-1.0)
+    lg.good, lg.breached = 100, 0
+    assert lg.budget_remaining() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# burn rates: hand-computed windows, exact merge, group aggregation
+
+# All ring math below uses explicit epoch stamps on a 1s grid; NOW sits
+# at an exact slot boundary so the hand-computed windows are unambiguous
+# (window() excludes the newest, still-filling slot).
+NOW = 1_000_000.0
+
+
+def _ring(events):
+    """events: (series, value, seconds_before_now)."""
+    ts = TimeSeries(interval_s=1.0)
+    for name, value, ago in events:
+        ts.record(name, value, "rate", t=NOW - ago)
+    return ts
+
+
+def test_burn_rates_hand_computed():
+    """90 good + 10 breached inside the fast window at a 99% objective:
+    breached fraction 0.1 against an allowed 0.01 = burn 10.0.  The slow
+    window additionally holds older all-good traffic, diluting the
+    fraction to 100/1000."""
+    policy = SLOPolicy(objective=0.99, fast_window_s=5.0, slow_window_s=60.0)
+    ts = _ring(
+        [("slo_good", 18.0, a) for a in (0.5, 1.5, 2.5, 3.5, 4.5)]
+        + [("slo_breached", 2.0, a) for a in (0.5, 1.5, 2.5, 3.5, 4.5)]
+        + [("slo_good", 90.0, a) for a in range(6, 16)]
+    )
+    b = burn_rates(ts, policy, now=NOW)
+    # fast: 90 good + 10 breached -> frac 0.1 -> burn 10x
+    assert b["fast_burn"] == pytest.approx(10.0)
+    assert b["fast_good_per_sec"] == pytest.approx(90 / 5)
+    assert b["fast_breached_per_sec"] == pytest.approx(10 / 5)
+    # slow: (90 + 900) good + 10 breached -> frac 0.01 -> burn 1x
+    assert b["slow_burn"] == pytest.approx(1.0)
+    assert b["burn_threshold"] == policy.burn_threshold
+
+
+def test_idle_window_burns_zero_but_trickle_burns_full():
+    """No traffic spends no budget; a stalled-but-trickling group where
+    EVERY request breaches burns 1/error_budget regardless of rate."""
+    policy = SLOPolicy(objective=0.99)
+    assert burn_rates(_ring([]), policy, now=NOW)["fast_burn"] == 0.0
+    trickle = _ring([("slo_breached", 1.0, 2.5)])
+    assert burn_rates(trickle, policy, now=NOW)["fast_burn"] == (
+        pytest.approx(100.0)
+    )
+
+
+def test_burn_merges_exactly_across_processes():
+    """The cluster-burn claim: merging per-process rings slot-wise then
+    computing burn equals computing burn over the hand-added totals —
+    no approximation, any merge order."""
+    policy = SLOPolicy(objective=0.99)
+    a = _ring([("slo_good", 40.0, 1.5), ("slo_breached", 4.0, 2.5)])
+    b = _ring([("slo_good", 50.0, 1.5), ("slo_breached", 6.0, 1.5)])
+    merged_ab = TimeSeries.merged([a, b])
+    merged_ba = TimeSeries.merged([b, a])
+    expect = ((4 + 6) / (40 + 50 + 4 + 6)) / policy.error_budget
+    for m in (merged_ab, merged_ba):
+        assert burn_rates(m, policy, now=NOW)["fast_burn"] == (
+            pytest.approx(round(expect, 3))
+        )
+
+
+def test_burn_group_selection_and_aggregation():
+    """Per-group series (slo_good_g{G}) let one ring carry every
+    group's counters: group=K reads one group, group=None sums all —
+    the cluster-burn aggregation `peer slo` renders."""
+    policy = SLOPolicy(objective=0.99)
+    ts = _ring([
+        ("slo_good_g0", 99.0, 1.5),
+        ("slo_breached_g0", 1.0, 1.5),
+        ("slo_good_g1", 50.0, 1.5),
+        ("slo_breached_g1", 50.0, 1.5),
+    ])
+    g0 = burn_rates(ts, policy, now=NOW, group=0)
+    g1 = burn_rates(ts, policy, now=NOW, group=1)
+    both = burn_rates(ts, policy, now=NOW, group=None)
+    assert g0["fast_burn"] == pytest.approx(1.0)
+    assert g1["fast_burn"] == pytest.approx(50.0)
+    assert both["fast_burn"] == pytest.approx(
+        round((51 / 200) / 0.01, 3)
+    )
+
+
+def test_register_slo_series_feeds_counter_deltas():
+    """register_slo_series rides the CounterSampler counter-delta
+    discipline: the first tick only baselines, later ticks record the
+    per-interval increments under the per-group series names."""
+    ts = TimeSeries(interval_s=1.0)
+    sampler = CounterSampler(ts)
+    lg = BudgetLedger(SLOPolicy(), group=2)
+    register_slo_series(sampler, lg)
+    sampler.tick(t=NOW - 3.5)  # baseline only
+    lg.good, lg.breached = 7, 3
+    sampler.tick(t=NOW - 2.5)
+    lg.good, lg.breached = 10, 3
+    sampler.tick(t=NOW - 1.5)
+    win = ts.window(5.0, now=NOW)
+    assert win[series_name("slo_good", 2)] == pytest.approx(10 / 5)
+    assert win[series_name("slo_breached", 2)] == pytest.approx(3 / 5)
+    assert series_name("slo_good", None) == "slo_good"
+
+
+# ---------------------------------------------------------------------------
+# breach attribution: the sums-to-spend invariant
+
+
+def _sum_attribution(rep):
+    return sum(rep["attribution_ms"].values())
+
+
+def test_breach_attribution_sums_to_spend_client_origin():
+    """Every request in the synthetic cluster takes ~16.1ms client to
+    quorum; a 10ms budget breaches all of them and the per-segment
+    attribution must sum to the breached spend (per-request segments
+    telescope to per-request totals by construction)."""
+    docs, _ = synth_docs(n_req=6)
+    rep = breach_report(docs, SLOPolicy(target_ms=10.0))
+    assert rep["origin"] == "client"
+    assert rep["requests"] == 6 and rep["breached"] == 6
+    assert rep["good_fraction"] == 0.0
+    assert _sum_attribution(rep) == pytest.approx(
+        rep["breached_spend_ms"], abs=0.01
+    )
+    # a 20ms budget clears every request: no spend, no attribution
+    ok = breach_report(docs, SLOPolicy(target_ms=20.0))
+    assert ok["breached"] == 0 and ok["good_fraction"] == 1.0
+    assert ok["breached_spend_ms"] == 0.0 and ok["attribution_ms"] == {}
+
+
+def test_breach_attribution_replica_origin_fallback():
+    """With no client dump (the loadgen harness keeps no client
+    recorders) classification falls back to recv-origin paths built
+    from the replica stages alone — the invariant holds there too."""
+    # one host (the loadgen in-process shape): replicas share a clock
+    # domain, so alignment is exact without a client hub
+    all_docs, _ = synth_docs(n_req=4, domains=["host"] * 4)
+    docs = [d for d in all_docs if d.get("kind") != "client"]
+    rep = breach_report(docs, SLOPolicy(target_ms=5.0))
+    assert rep["origin"] == "replica"
+    assert rep["requests"] == 4 and rep["breached"] == 4
+    assert _sum_attribution(rep) == pytest.approx(
+        rep["breached_spend_ms"], abs=0.01
+    )
+
+
+def test_breach_attribution_scheduled_origin_adds_sched_wait():
+    """A loadgen metadata doc switches classification to SCHEDULED
+    origin (the coordinated-omission rule): each request's pre-entry
+    wait lands in an explicit sched_wait segment, totals grow to the
+    scheduled latency, and the invariant still holds exactly.  Requests
+    that clear the budget client-origin can breach scheduled-origin —
+    that asymmetry IS the point of the rule."""
+    docs, _ = synth_docs(n_req=5, client_id=7)
+    paths = critpath.cluster_paths(docs).paths
+    assert len(paths) == 5
+    sched = {
+        f"7:{p.seq}": p.total_ns + 5 * MS  # waited 5ms before entry
+        for p in paths
+    }
+    docs.append({"kind": "loadgen", "sched_lat_ns": sched})
+    # 20ms clears client-origin (~16.1ms) but not scheduled (~21.1ms)
+    rep = breach_report(docs, SLOPolicy(target_ms=20.0))
+    assert rep["origin"] == "scheduled"
+    assert rep["breached"] == 5
+    assert rep["attribution_ms"].get(slo.SCHED_WAIT_SEGMENT, 0.0) == (
+        pytest.approx(25.0, abs=0.01)
+    )
+    assert _sum_attribution(rep) == pytest.approx(
+        rep["breached_spend_ms"], abs=0.01
+    )
+
+
+# ---------------------------------------------------------------------------
+# forensics: token bucket + spool bound under sustained breach
+
+
+def test_token_bucket_starts_full_and_refills():
+    tb = TokenBucket(capacity=1.0, refill_s=100.0, now=0.0)
+    assert tb.take(now=0.0)  # the first breach deserves its bundle
+    assert not tb.take(now=50.0)  # half a refill: still dry
+    assert tb.take(now=151.0)  # refilled
+    assert not tb.take(now=152.0)
+
+
+def test_spool_rate_limit_and_bound(tmp_path):
+    """Sustained synthetic breach against both defenses: the bucket
+    refuses dump 2 (rate), the spool bound refuses dump 4 (size), and
+    the suppressed path never even BUILDS the lazy bundle."""
+    import time as _time
+
+    spool = BreachSpool(str(tmp_path), max_bundles=2, refill_s=100.0)
+    base = _time.monotonic()  # the bucket's clock origin (starts full)
+    built = []
+
+    def bundle():
+        built.append(1)
+        return {"kind": "slo_breach", "n": len(built)}
+
+    p1 = spool.maybe_dump(bundle, now=base)
+    assert p1 is not None and os.path.exists(p1)
+    assert json.load(open(p1))["kind"] == "slo_breach"
+    assert spool.written == 1 and spool.bundle_count() == 1
+
+    assert spool.maybe_dump(bundle, now=base + 1.0) is None  # bucket dry
+    assert spool.suppressed == 1 and len(built) == 1  # not built
+
+    p2 = spool.maybe_dump(bundle, now=base + 200.0)  # bucket refilled
+    assert p2 is not None and spool.bundle_count() == 2
+
+    # spool bound: at max_bundles the write is refused even with tokens
+    assert spool.maybe_dump(bundle, now=base + 900.0) is None
+    assert spool.suppressed == 2 and len(built) == 2
+    assert spool.bundle_count() == 2  # bounded on disk, not per-process
+
+
+def test_spool_bound_counts_files_not_this_process(tmp_path):
+    """Restart honesty: the bound counts slo_breach.*.json FILES, so a
+    restarted process shares the bound with its predecessor's spool."""
+    (tmp_path / "slo_breach.old-run.0.json").write_text("{}")
+    spool = BreachSpool(str(tmp_path), max_bundles=1, refill_s=1.0)
+    assert spool.maybe_dump({"kind": "slo_breach"}, now=0.0) is None
+    assert spool.suppressed == 1
+
+
+def test_spool_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv(slo.DUMP_ENV, raising=False)
+    assert BreachSpool.from_env() is None
+    monkeypatch.setenv(slo.DUMP_ENV, str(tmp_path))
+    monkeypatch.setenv(slo.DUMP_MAX_ENV, "7")
+    monkeypatch.setenv(slo.DUMP_REFILL_ENV, "42")
+    spool = BreachSpool.from_env()
+    assert spool.directory == str(tmp_path)
+    assert spool.max_bundles == 7 and spool.bucket.refill_s == 42.0
+
+
+def test_watch_dumps_once_on_threshold_crossing(tmp_path):
+    """The auto-dump trigger loop: a ring whose fast window is pure
+    breach crosses the threshold on the first poll, dumps exactly one
+    bundle (the bucket holds the second), and the task cancels clean."""
+    import time as _time
+
+    policy = SLOPolicy(objective=0.99, burn_threshold=8.0)
+    ts = TimeSeries(interval_s=1.0)
+    now = _time.time()
+    for ago in (1.5, 2.5):
+        ts.record("slo_breached", 5.0, "rate", t=now - ago)
+    spool = BreachSpool(str(tmp_path), max_bundles=4, refill_s=3600.0)
+    lg = BudgetLedger(policy)
+    lg.breached = 10
+
+    def bundle_fn(burn):
+        return slo.build_bundle(policy, burn, [lg], timeseries=ts)
+
+    async def run():
+        task = asyncio.get_running_loop().create_task(
+            slo.watch(ts, policy, spool, bundle_fn, interval_s=0.02)
+        )
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if spool.written and spool.suppressed:
+                break
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(run())
+    assert spool.written == 1  # exactly one bundle; the bucket held
+    assert spool.suppressed >= 1
+    bundles = sorted(tmp_path.glob("slo_breach.*.json"))
+    assert len(bundles) == 1
+    doc = json.load(open(bundles[0]))
+    assert doc["kind"] == "slo_breach"
+    assert doc["burn"]["fast_burn"] >= policy.burn_threshold
+    assert doc["ledgers"][0]["breached"] == 10
+    assert doc["policy"]["target_ms"] == policy.target_ms
+
+
+def test_build_bundle_embeds_breach_report_and_ring():
+    docs, _ = synth_docs(n_req=3)
+    policy = SLOPolicy(target_ms=10.0)
+    ts = _ring([("slo_breached", 3.0, 1.5)])
+    burn = burn_rates(ts, policy, now=NOW)
+    lg = BudgetLedger(policy, group=0)
+    lg.good, lg.breached, lg.breached_budget_ns = 1, 3, 50 * MS
+
+    class FakeRecorder:
+        def __init__(self, doc):
+            self._doc = doc
+
+        def to_dict(self):
+            return self._doc
+
+    bundle = slo.build_bundle(
+        policy, burn, [lg],
+        recorders=[FakeRecorder(d) for d in docs],
+        timeseries=ts, util={"busy": 0.5},
+    )
+    assert bundle["kind"] == "slo_breach"
+    assert bundle["breach"]["breached"] == 3
+    assert _sum_attribution(bundle["breach"]) == pytest.approx(
+        bundle["breach"]["breached_spend_ms"], abs=0.01
+    )
+    assert bundle["ledgers"][0] == {
+        "group": 0, "good": 1, "breached": 3,
+        "breached_budget_ms": 50.0, "budget_remaining": -74.0,
+    }
+    assert bundle["util"] == {"busy": 0.5}
+    assert "slo_breached" in bundle["timeseries"]["series"]
+    # the bundle is one self-contained JSON document
+    json.dumps(bundle)

@@ -260,7 +260,15 @@ class SchemaDriftConfig:
         "_goodput_per_sec",
     )
     # Suffixes whose emitted families must appear in the schema header.
-    documented_suffixes: Tuple[str, ...] = ("_per_sec",)
+    documented_suffixes: Tuple[str, ...] = (
+        "_per_sec",
+        # SLO surface (ISSUE 19): the finality/goodness pair is emitted
+        # at every curve and grid point and the p99 half is gated, so
+        # drift between bench.py, benchgate, and the schema header is
+        # exactly what SD704 exists to catch.
+        "_finality_p99_ms",
+        "_slo_good_fraction",
+    )
     # Emitted families exempt from SD701/SD704 with a reason each
     # (progress/diagnostic keys that are deliberately not gated).
     exempt: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -573,6 +581,36 @@ def default_config() -> AnalyzeConfig:
                 cls="MultiGroupClient",
                 locks=(),
                 guarded=("_clients", "router"),
+            ),
+            # SLO budget ledgers (obs/slo.py, ISSUE 19): arrive/commit
+            # run on the owning replica's event loop (sync bodies, so
+            # loop-atomic); the scrape thread only reads GIL-atomic ints
+            # — the StageRing single-writer discipline.
+            LockClassSpec(
+                path="minbft_tpu/obs/slo.py",
+                cls="BudgetLedger",
+                locks=(),
+                guarded=(
+                    "good",
+                    "breached",
+                    "breached_budget_ns",
+                    "_origin",
+                ),
+            ),
+            # The breach spool's counters are written only by the watch
+            # task / loadgen runner on one loop; maybe_dump() is sync end
+            # to end (the disk write is the suspension-free tail).
+            LockClassSpec(
+                path="minbft_tpu/obs/slo.py",
+                cls="BreachSpool",
+                locks=(),
+                guarded=("written", "suppressed"),
+            ),
+            LockClassSpec(
+                path="minbft_tpu/obs/slo.py",
+                cls="TokenBucket",
+                locks=(),
+                guarded=("_tokens", "_t"),
             ),
             # The software USIG's counter is certified-then-incremented
             # under a real threading.Lock (reference ecallLock).

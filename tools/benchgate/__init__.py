@@ -70,6 +70,10 @@ _LOAD_PREFIX = "load_"
 _GRID_LOAD_RE = re.compile(r"^groups\d+x\d+_load_")
 _LOAD_GOODPUT_SUFFIX = "_goodput_per_sec"
 _LOAD_P99_SUFFIX = "_p99_ms"
+# SLO finality headline (perf/SLO.md): scheduled-origin finality p99
+# with unresolved requests charged their age-so-far.  Gated on INCREASE
+# like the plain p99 (and matched FIRST — it also ends in "_p99_ms").
+_LOAD_FINALITY_SUFFIX = "_finality_p99_ms"
 
 
 def _in_load_namespace(key: str) -> bool:
@@ -144,6 +148,11 @@ def gated_pairs(
             _LOAD_GOODPUT_SUFFIX
         ):
             prefix = key[: -len("_per_sec")]
+        elif _in_load_namespace(key) and key.endswith(
+            _LOAD_FINALITY_SUFFIX
+        ):
+            prefix = key[: -len("_ms")]
+            direction = "increase"
         elif _in_load_namespace(key) and key.endswith(
             _LOAD_P99_SUFFIX
         ):
