@@ -80,10 +80,12 @@ lint:
 	    echo "ruff/pyflakes not installed; tools/analyze dead-code pass is the floor"; \
 	fi
 
-# Unit tier: everything except the multi-process / deploy / soak suites —
-# the reference's `go test -short` equivalent.
+# Unit tier: everything except the multi-process / deploy / soak suites
+# (whole files by --ignore, individual soaks by the `slow` marker — the
+# kill-9 recovery soak lives in an otherwise-fast file) — the
+# reference's `go test -short` equivalent.
 fast: native lint
-	$(PY) -m pytest tests/ -x -q \
+	$(PY) -m pytest tests/ -x -q -m "not slow" \
 	    --ignore=tests/test_process_cluster.py \
 	    --ignore=tests/test_peer_cli.py \
 	    --ignore=tests/test_deploy.py \

@@ -152,6 +152,19 @@ bench_extras line carries the headline-grade subset):
       grid meta: the swept axes post-clamp (chips clamps to visible
       devices — C=1 only on the CPU container), what was asked for, and
       how many devices the run saw
+  chaos_recovery_time_ms / chaos_recovery_goodput_per_sec /
+  chaos_recovery_restored_count / chaos_recovery_wall_ms /
+  chaos_recovery_seed / chaos_recovery_requests /
+  chaos_recovery_census_ok
+      crash-recovery soak (testing/recovery_soak.py, ISSUE 20): kill -9
+      one real ``peer run`` replica mid-load under a pinned chaos seed
+      and restart it against its durable --state-dir store.  Recovery
+      time is the restarted replica's OWN minbft_recovery_time_ms
+      (durable restore -> catch-up -> first executed request); goodput
+      is the whole-run committed rate INCLUDING the outage window (the
+      bench awaits every request, so a clean run is the zero-loss
+      proof).  benchgate gates the time on increase (latency floor) and
+      the goodput on drop.
   uvloop   True when MINBFT_UVLOOP (auto-detect) put uvloop behind the
       bench's event loops — numbers are never silently attributed to
       the wrong loop
@@ -176,7 +189,13 @@ Environment knobs:
   MINBFT_BENCH_SLO_P50_MS   latency target for the *_at_p50_* runs (500)
   MINBFT_BENCH_SKIP_E2E / _SKIP_MP / _SKIP_NODEDUP / _SKIP_SLO /
   _SKIP_CONFIGS / _SKIP_SIGN / _SKIP_ED25519 / _SKIP_RO /
-  _SKIP_INGEST / _SKIP_GROUPS / _SKIP_LOAD / _SKIP_GRID   phase gates
+  _SKIP_INGEST / _SKIP_GROUPS / _SKIP_LOAD / _SKIP_GRID /
+  _SKIP_RECOVERY            phase gates
+  MINBFT_BENCH_RECOVERY_REQUESTS   recovery-soak load (198 — must
+                            outlive the kill/restart outage, see
+                            bench_recovery)
+  MINBFT_BENCH_RECOVERY_SEED       recovery-soak chaos seed
+                            (0x2020C0FFEE)
   MINBFT_BENCH_GROUPS_REQUESTS   per-group sweep load (400 with OpenSSL
                                  host crypto, 48 pure-Python containers)
   MINBFT_BENCH_GRID_GS      (G, chips) grid group counts ("2,4,8" — G=1
@@ -2139,6 +2158,49 @@ def bench_groups_chips() -> dict:
     return out
 
 
+def bench_recovery() -> dict:
+    """Crash-recovery soak headline (ISSUE 20): one
+    :func:`minbft_tpu.testing.recovery_soak.run_recovery_soak` round —
+    real ``peer run`` OS processes with durable ``--state-dir`` stores
+    under the seeded chaos wrap, ``kill -9`` one replica mid-load,
+    restart it against the same store.  The soak itself raises on any
+    acceptance miss (committed loss, no durable restore, store-invariant
+    break, census drift), so a number in the artifact means the run also
+    PASSED; this function only reshapes the report into the two gated
+    headlines plus provenance.  Load must outlive the outage — the
+    recovery clock stops at the restarted replica's first executed
+    request, and a bench that drains during the reboot leaves the clock
+    running forever — hence the default request budget is sized for
+    ~30s+ of load on the 1-core host."""
+    import tempfile
+
+    from minbft_tpu.testing.recovery_soak import run_recovery_soak
+
+    seed = int(
+        os.environ.get("MINBFT_BENCH_RECOVERY_SEED", "0x2020C0FFEE"), 0
+    )
+    requests = int(
+        os.environ.get("MINBFT_BENCH_RECOVERY_REQUESTS", "198")
+    )
+    with tempfile.TemporaryDirectory(prefix="minbft-recovery-") as wd:
+        rep = run_recovery_soak(
+            wd, replicas=4, requests=requests, clients=6, depth=4,
+            checkpoint_period=4, chunk_bytes=2048, chaos_seed=seed,
+            down_s=0.5,
+        )
+    return {
+        "chaos_recovery_time_ms": rep["chaos_recovery_time_ms"],
+        "chaos_recovery_goodput_per_sec": rep[
+            "chaos_recovery_goodput_per_sec"
+        ],
+        "chaos_recovery_restored_count": rep["restored_count"],
+        "chaos_recovery_wall_ms": rep["wall_recovery_ms"],
+        "chaos_recovery_seed": hex(seed),
+        "chaos_recovery_requests": rep["requested"],
+        "chaos_recovery_census_ok": bool(rep.get("census")),
+    }
+
+
 def _last_tpu_numbers() -> "dict | None":
     """Carry-forward block for CPU-fallback runs: the newest committed
     BENCH_r*.json produced on a real TPU backend, so a reader of this
@@ -2373,6 +2435,18 @@ def main() -> None:
                 json.dumps({"grid_run": f"failed: {e}"[:300]}),
                 file=sys.stderr, flush=True,
             )
+    if not os.environ.get("MINBFT_BENCH_SKIP_RECOVERY"):
+        # Crash-recovery soak (ISSUE 20): kill -9 a real peer process
+        # mid-load under the pinned chaos seed and read the recovery
+        # SLO off the restarted replica's own metrics.  Host-path work
+        # (real OS processes, no device), meaningful on every backend.
+        try:
+            extras.update(bench_recovery())
+        except Exception as e:  # noqa: BLE001 - the soak is additive
+            print(
+                json.dumps({"recovery_run": f"failed: {e}"[:300]}),
+                file=sys.stderr, flush=True,
+            )
     if not os.environ.get("MINBFT_BENCH_SKIP_RO"):
         ro_reads = int(os.environ.get("MINBFT_BENCH_RO_READS", "4000"))
         if jax.default_backend() == "cpu" and ro_reads > 400:
@@ -2570,6 +2644,7 @@ def main() -> None:
         "_util_",
         "queue_depth_peak",
         "load_",
+        "chaos_recovery_",
     )
     compact = {
         k: extras[k] for k in sorted(extras) if any(p in k for p in keep)

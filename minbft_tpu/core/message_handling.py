@@ -43,6 +43,9 @@ from ..messages import (
     Request,
     SnapshotReq,
     SnapshotResp,
+    StateChunk,
+    StateDone,
+    StateReq,
     UNICAST_LOG_MESSAGES,
     ViewChange,
     authen_bytes,
@@ -65,6 +68,9 @@ from . import usig_ui, utils
 from . import viewchange as viewchange_mod
 from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
+from ..recovery import manager as recovery_mod
+from ..recovery import store as recovery_store
+from ..recovery import transfer as recovery_transfer
 from ..utils.backoff import ReconnectBackoff
 from ..utils.metrics import ReplicaMetrics
 from .internal.clientstate import ClientStates
@@ -161,6 +167,7 @@ class Handlers:
         client_states: ClientStates,
         logger: Optional[logging.Logger] = None,
         group: Optional[int] = None,
+        recovery: Optional["recovery_mod.RecoveryManager"] = None,
     ):
         self.replica_id = replica_id
         self.n = n
@@ -514,6 +521,17 @@ class Handlers:
         self._snapshot_expect: Optional[Checkpoint] = None
         self._snapshot_sources: list = []  # claimants left to try
         self._snapshot_timer = None
+        # Chunked resumable state transfer (recovery subsystem): the
+        # assembler for the in-flight STATE-CHUNK stream, the peer it was
+        # requested from, and the verified offset at the last retry-timer
+        # fire (progress since then means resume-from-offset on the SAME
+        # source; no progress means fail over to the next one).
+        self._state_asm: Optional[recovery_transfer.ChunkAssembler] = None
+        self._state_source: Optional[int] = None
+        self._state_progress = 0
+        # Recovery telemetry + durable store handle (None = durability and
+        # recovery SLOs off; every hook below is one predicated check).
+        self.recovery = recovery
         self._pending_new_view: Optional[NewView] = None
         # Strong refs to fire-and-forget background tasks (the deferred
         # NEW-VIEW re-check): discarded by their done-callback.
@@ -568,6 +586,10 @@ class Handlers:
                 return
             self.metrics.observe_execute(time.monotonic() - t0)
             self.metrics.inc("requests_executed")
+            if self.recovery is not None:
+                # Stops the restart-to-first-executed-request clock; cheap
+                # no-op on every execution after the first.
+                self.recovery.note_executed()
             self.checkpoint_emitter.on_delivered()
 
         self.execute_request = execute_counted
@@ -706,7 +728,10 @@ class Handlers:
             await self.validate_view_change(msg)
         elif isinstance(msg, NewView):
             await self.validate_new_view(msg)
-        elif isinstance(msg, (Checkpoint, SnapshotReq, SnapshotResp)):
+        elif isinstance(
+            msg,
+            (Checkpoint, SnapshotReq, SnapshotResp, StateReq, StateChunk, StateDone),
+        ):
             await self.verify_signature(msg)
         elif isinstance(msg, LogBase):
             await self._validate_log_base(msg)
@@ -816,6 +841,12 @@ class Handlers:
             return await self._process_snapshot_req(msg)
         if isinstance(msg, SnapshotResp):
             return await self._process_snapshot_resp(msg)
+        if isinstance(msg, StateReq):
+            return await self._process_state_req(msg)
+        if isinstance(msg, StateChunk):
+            return await self._process_state_chunk(msg)
+        if isinstance(msg, StateDone):
+            return await self._process_state_done(msg)
         raise ValueError(f"unexpected message {stringify(msg)}")
 
     async def _process_peer_message(self, msg) -> bool:
@@ -987,6 +1018,7 @@ class Handlers:
             coll.stable_digest.hex()[:12],
         )
         self._maybe_truncate()
+        self._spawn_durable_save()
 
     def _note_stable_locally(self) -> None:
         """Propagate a stable-watermark change: the commitment collector
@@ -1212,7 +1244,15 @@ class Handlers:
             if p != self.replica_id and p not in sources:
                 sources.append(p)
         self._snapshot_sources = sources
-        self._send_snapshot_req()
+        # Re-targeting to a newer certificate abandons any partial stream
+        # for the old one (the chunks verified so far belong to a snapshot
+        # nobody needs anymore).
+        self._state_asm = None
+        self._state_source = None
+        self._state_progress = 0
+        if self.recovery is not None:
+            self.recovery.set_phase(recovery_mod.PHASE_FETCHING)
+        self._send_state_req()
 
     def _unicast_append(self, peer_id: int, msg) -> None:
         """THE unicast-log append point.  Only kinds in
@@ -1230,21 +1270,49 @@ class Handlers:
         if ulog is not None:
             ulog.append(msg)
 
-    def _send_snapshot_req(self) -> None:
+    def _send_state_req(self, resume: bool = False) -> None:
+        """Issue (or re-issue) the chunked STATE-REQ for the pending
+        target.  ``resume=True`` keeps the CURRENT source and asks it to
+        continue from the verified offset — the mid-transfer-reset path:
+        every chunk already assembled was chain-verified, so nothing needs
+        re-downloading.  ``resume=False`` rotates to the next source and
+        restarts from offset 0 (fresh fetch, or failover after a stalled /
+        corrupt stream)."""
         expect = self._snapshot_expect
         if expect is None or not self._snapshot_sources:
             return
-        via = self._snapshot_sources.pop(0)
-        self._snapshot_sources.append(via)  # retries cycle the claimants
+        asm = self._state_asm
+        if resume and self._state_source is not None and asm is not None:
+            via = self._state_source
+            # Resume the stream the assembler verified so far — which may
+            # be an upgraded (newer) snapshot than the original target.
+            count, offset = asm.count, asm.offset
+            self.metrics.inc("state_transfer_resumes")
+            if self.recovery is not None:
+                self.recovery.note_resume()
+        else:
+            via = self._snapshot_sources.pop(0)
+            self._snapshot_sources.append(via)  # retries cycle the claimants
+            if self._state_source is not None and via != self._state_source:
+                self.metrics.inc("state_transfer_failovers")
+                if self.recovery is not None:
+                    self.recovery.note_failover()
+            self._state_asm = None
+            count, offset = expect.count, 0
+        self._state_source = via
+        self._state_progress = offset
         self.metrics.inc("state_transfer_requests")
-        req = SnapshotReq(replica_id=self.replica_id, count=expect.count)
+        req = StateReq(replica_id=self.replica_id, count=count, offset=offset)
         self.sign_message(req)
         self._unicast_append(via, req)
 
         def on_expiry() -> None:
-            if self._snapshot_expect is not None:
-                self.metrics.inc("state_transfer_retries")
-                self._send_snapshot_req()
+            if self._snapshot_expect is None:
+                return
+            self.metrics.inc("state_transfer_retries")
+            cur = self._state_asm
+            progressed = cur is not None and cur.offset > self._state_progress
+            self._send_state_req(resume=progressed)
 
         if self._snapshot_timer is not None:
             self._snapshot_timer.cancel()
@@ -1285,44 +1353,218 @@ class Handlers:
         self._unicast_append(req.replica_id, resp)
         return True
 
-    async def _process_snapshot_resp(self, resp: SnapshotResp) -> bool:
-        """Install a transferred snapshot once it checks out against the
-        f+1-certified composite digest — then jump execution, watermarks,
-        and the view to the certified position and retry any view entry
-        that was waiting on the state."""
-        expect = self._snapshot_expect
-        if expect is None:
+    def _prune_state_unicast(self, peer_id: int) -> None:
+        """Drop the prefix of ``peer_id``'s unicast log consisting of
+        state-transfer payload frames — a fresh STATE-REQ supersedes every
+        stream we queued for this peer before (its signed offset tells us
+        exactly what it still needs, and the new stream re-sends that), so
+        retaining them only bloats the log and the reconnect replay.
+        Prefix-only: anything behind a non-state frame (e.g. a forwarded
+        REQUEST or our own outgoing STATE-REQ) is left alone."""
+        ulog = self.unicast_logs.get(peer_id)
+        if ulog is None:
+            return
+        n_drop = 0
+        for m in ulog.snapshot():
+            if isinstance(m, (SnapshotResp, StateChunk, StateDone)):
+                n_drop += 1
+            else:
+                break
+        if n_drop:
+            ulog.truncate(n_drop)
+
+    async def _process_state_req(self, req: StateReq) -> bool:
+        """Serve a chunked snapshot stream (the resumable counterpart of
+        ``_process_snapshot_req``): deterministic fixed-size chunks, each
+        signed and carrying the running chain digest recomputed from byte
+        zero — so a requester resuming at ``req.offset`` receives chunks
+        whose chain commits to the entire prefix it already verified."""
+        snap = self.checkpoint_emitter.snapshot_for(req.count)
+        count, cert = req.count, ()
+        if snap is None:
+            # The exact snapshot aged out of the retention window: offer
+            # our newest certified one instead (certificate attached on
+            # the DONE frame so the requester can verify and upgrade).
+            coll = self.checkpoint_collector
+            if coll.stable_count > req.count:
+                snap = self.checkpoint_emitter.snapshot_for(coll.stable_count)
+                count = coll.stable_count
+                cert = tuple(coll.stable_certificate[: self.f + 1])
+        if snap is None:
+            self.log.info(
+                "no retained snapshot at count %d for replica %d",
+                req.count,
+                req.replica_id,
+            )
             return False
-        if resp.count == expect.count:
-            target = expect
-        elif resp.count > expect.count and resp.cert:
-            # The responder's retention window moved past our target: it
-            # offered a newer certified snapshot — verify its certificate
-            # independently and upgrade.
+        view, cv, app, marks = snap
+        self._prune_state_unicast(req.replica_id)
+        total = len(app)
+        # A resume offset only applies to the stream it measured; an
+        # upgraded (newer) snapshot restarts from zero.  Offsets are
+        # chunk-aligned by construction — a stale/misaligned one degrades
+        # into the requester's failover path, never into bad bytes.
+        offset = min(req.offset, total) if count == req.count else 0
+        rec = self.recovery
+        chain = b""
+        for off, piece in recovery_transfer.iter_chunks(
+            app, recovery_transfer.chunk_bytes()
+        ):
+            chain = recovery_transfer.chain_extend(chain, piece)
+            if off < offset:
+                continue  # the requester already verified this prefix
+            ck = StateChunk(
+                replica_id=self.replica_id,
+                count=count,
+                offset=off,
+                total=total,
+                data=piece,
+                chain=chain,
+            )
+            self.sign_message(ck)
+            self._unicast_append(req.replica_id, ck)
+            self.metrics.inc("state_chunks_sent")
+            if rec is not None:
+                rec.note_chunk_tx(len(piece))
+        done = StateDone(
+            replica_id=self.replica_id,
+            count=count,
+            view=view,
+            cv=cv,
+            total=total,
+            watermarks=tuple(marks),
+            cert=cert,
+        )
+        self.sign_message(done)
+        self._unicast_append(req.replica_id, done)
+        return True
+
+    async def _process_state_chunk(self, ck: StateChunk) -> bool:
+        """Assemble one verified chunk of the in-flight stream.  Chunks
+        from peers we did not ask, for streams we are not assembling, or
+        below the verified offset (reconnect replays) are ignored
+        idempotently; a chain mismatch is Byzantine evidence and fails the
+        fetch over to the next source immediately."""
+        if self._snapshot_expect is None or ck.replica_id != self._state_source:
+            return False
+        asm = self._state_asm
+        if asm is None:
+            # First chunk of a fresh stream: must start at zero, and may
+            # carry a NEWER count than requested (the responder upgraded;
+            # certified at the DONE frame before anything installs).
+            if ck.offset != 0 or ck.count < self._snapshot_expect.count:
+                return False
+            asm = self._state_asm = recovery_transfer.ChunkAssembler(ck.count)
+        if ck.count != asm.count:
+            return False  # stale replay from a superseded stream
+        try:
+            fresh = asm.add(ck.offset, ck.total, ck.data, ck.chain)
+        except recovery_transfer.ChainMismatch as e:
+            self.log.warning(
+                "corrupt state chunk from replica %d at offset %d: %s — "
+                "failing over",
+                ck.replica_id,
+                ck.offset,
+                e,
+            )
+            self.metrics.inc("state_transfer_corrupt")
+            self._state_asm = None
+            self._send_state_req()
+            return False
+        if fresh:
+            self.metrics.inc("state_chunks_received")
+            if self.recovery is not None:
+                self.recovery.note_chunk_rx(len(ck.data))
+        return fresh
+
+    async def _process_state_done(self, done: StateDone) -> bool:
+        """Terminal frame of a chunk stream: resolve the certified target
+        (expected or upgraded), check the assembled length, and install
+        through the same verified sequence as a monolithic SNAPSHOT-RESP.
+        A stream that assembled cleanly but fails the f+1-certified
+        composite digest is Byzantine (self-consistent garbage) — fail
+        over to the next source."""
+        if self._snapshot_expect is None or done.replica_id != self._state_source:
+            return False
+        asm = self._state_asm
+        if asm is not None:
+            if done.count != asm.count:
+                return False
+            if asm.offset != done.total:
+                # Incomplete (a DONE replayed ahead of its chunks after a
+                # reset): the retry timer resumes from the verified
+                # offset; nothing to do now.
+                return False
+            app = asm.bytes()
+        else:
+            # Empty-snapshot stream: no chunks at all, just the DONE.
+            if done.total != 0 or done.count < self._snapshot_expect.count:
+                return False
+            app = b""
+        target = await self._resolve_transfer_target(
+            done.count, done.view, done.cv, done.cert
+        )
+        if target is None:
+            ok = False
+        else:
+            ok = await self._finish_state_transfer(
+                target,
+                done.count,
+                done.view,
+                done.cv,
+                app,
+                tuple(done.watermarks),
+                done.replica_id,
+            )
+        if not ok and self._snapshot_expect is not None:
+            self.metrics.inc("state_transfer_corrupt")
+            self._state_asm = None
+            self._send_state_req()
+        return ok
+
+    async def _resolve_transfer_target(self, count, view, cv, cert):
+        """Map a transfer payload's claimed position to the certified
+        target checkpoint: the expected one, or — when the responder's
+        retention window moved past it — a NEWER one vouched by the
+        attached certificate (verified independently, then adopted).
+        Returns None when the payload matches neither."""
+        expect = self._snapshot_expect
+        if count == expect.count:
+            return expect
+        if count > expect.count and cert:
             try:
-                target = await self.validate_checkpoint_cert(resp.cert)
+                target = await self.validate_checkpoint_cert(cert)
             except api.AuthenticationError as e:
                 self.log.warning("bad snapshot-upgrade cert: %s", e)
-                return False
-            if (target.count, target.view, target.cv) != (
-                resp.count,
-                resp.view,
-                resp.cv,
-            ):
-                return False
-            self._adopt_cert(resp.cert)
-        else:
-            return False
-        if self.checkpoint_emitter.count >= resp.count:
+                return None
+            if (target.count, target.view, target.cv) != (count, view, cv):
+                return None
+            self._adopt_cert(cert)
+            return target
+        return None
+
+    def _clear_state_transfer(self) -> None:
+        self._snapshot_expect = None
+        self._snapshot_sources = []
+        self._state_asm = None
+        self._state_source = None
+        self._state_progress = 0
+        if self._snapshot_timer is not None:
+            self._snapshot_timer.cancel()
+            self._snapshot_timer = None
+
+    async def _finish_state_transfer(
+        self, target, count, view, cv, app, watermarks, source
+    ) -> bool:
+        """Verify a fully-transferred snapshot against the f+1-certified
+        composite digest and install it — the shared tail of the
+        monolithic (SNAPSHOT-RESP) and chunked (STATE-DONE) paths."""
+        if self.checkpoint_emitter.count >= count:
             # We caught up past the snapshot while it was in flight (e.g.
             # replaying full history from an untruncated peer): installing
             # now would REWIND the application state below the retire
             # watermarks and diverge this replica forever.
-            self._snapshot_expect = None
-            self._snapshot_sources = []
-            if self._snapshot_timer is not None:
-                self._snapshot_timer.cancel()
-                self._snapshot_timer = None
+            self._clear_state_transfer()
             # A NEW-VIEW deferred behind this transfer must not die with
             # it: the catch-up that made the snapshot stale may equally
             # have carried us past the NEW-VIEW's anchor (and if it did
@@ -1332,49 +1574,208 @@ class Handlers:
             await self._maybe_apply_pending_new_view()
             return False
         try:
-            app_digest = self.consumer.snapshot_digest(resp.app_state)
+            app_digest = self.consumer.snapshot_digest(app)
         except (ValueError, NotImplementedError) as e:
-            self.log.warning("rejected snapshot at %d: %r", resp.count, e)
+            self.log.warning("rejected snapshot at %d: %r", count, e)
             return False
         composite = checkpoint_mod.checkpoint_digest(
-            app_digest, resp.count, resp.view, resp.cv, resp.watermarks
+            app_digest, count, view, cv, watermarks
         )
-        if composite != target.digest or (resp.view, resp.cv) != (
-            target.view,
-            target.cv,
-        ):
+        if composite != target.digest or (view, cv) != (target.view, target.cv):
             self.log.warning(
                 "snapshot at %d does not match the certified digest "
                 "(from replica %d)",
-                resp.count,
-                resp.replica_id,
+                count,
+                source,
             )
             return False
-        self.consumer.install_snapshot(resp.app_state)
-        self.client_states.install_retire_watermarks(resp.watermarks)
-        self.commitment_collector.install_checkpoint(resp.view, resp.cv)
-        self.checkpoint_emitter.install(resp.count)
-        self._exec_pos = (resp.view, resp.cv)
-        self._snapshot_expect = None
-        self._snapshot_sources = []
-        if self._snapshot_timer is not None:
-            self._snapshot_timer.cancel()
-            self._snapshot_timer = None
+        rec = self.recovery
+        if rec is not None:
+            rec.set_phase(recovery_mod.PHASE_INSTALLING)
+        self.consumer.install_snapshot(app)
+        self.client_states.install_retire_watermarks(watermarks)
+        self.commitment_collector.install_checkpoint(view, cv)
+        self.checkpoint_emitter.install(count)
+        self._exec_pos = (view, cv)
+        self._clear_state_transfer()
         self.metrics.inc("state_transfers")
         self.log.info(
             "state transfer complete: installed certified state at "
             "count %d (view %d cv %d) from replica %d",
+            count,
+            view,
+            cv,
+            source,
+        )
+        if rec is not None:
+            # The broadcast-log replay delta-catches-up the tail from here.
+            rec.set_phase(recovery_mod.PHASE_CATCHUP)
+        cur, _ = await self.view_state.hold_view()
+        if view > cur:
+            await self.view_state.advance_expected_view(view)
+            await self.view_state.advance_current_view(view)
+        await self._maybe_apply_pending_new_view()
+        return True
+
+    async def _process_snapshot_resp(self, resp: SnapshotResp) -> bool:
+        """Install a transferred snapshot once it checks out against the
+        f+1-certified composite digest — then jump execution, watermarks,
+        and the view to the certified position and retry any view entry
+        that was waiting on the state."""
+        if self._snapshot_expect is None:
+            return False
+        target = await self._resolve_transfer_target(
+            resp.count, resp.view, resp.cv, resp.cert
+        )
+        if target is None:
+            return False
+        return await self._finish_state_transfer(
+            target,
             resp.count,
             resp.view,
             resp.cv,
+            resp.app_state,
+            tuple(resp.watermarks),
             resp.replica_id,
         )
+
+    # ------------------------------------------------------------------
+    # Durable checkpoint store (recovery subsystem): persist every new
+    # stable position, restore it crash-consistently at startup.
+
+    def _own_ui_counter(self) -> int:
+        """Highest own USIG counter this replica has certified — the
+        watermark persisted alongside the stable state.  The broadcast log
+        holds every certified entry above the truncation base, so the
+        newest one (scanned from the tail) plus the base bounds it."""
+        hi = self._own_log_base[0]
+        for m in reversed(self.message_log.snapshot()):
+            ui = getattr(m, "ui", None)
+            if ui is not None:
+                return max(hi, ui.counter)
+        return hi
+
+    def _spawn_durable_save(self) -> None:
+        """Persist the freshly-stabilized position off-loop.  Never
+        persists unverified bytes: the snapshot is recomputed against the
+        stable composite digest first, so the store only ever holds state
+        the f+1 certificate actually vouches for."""
+        rec = self.recovery
+        if rec is None or rec.store is None:
+            return
+        coll = self.checkpoint_collector
+        count = coll.stable_count
+        snap = self.checkpoint_emitter.snapshot_for(count)
+        if snap is None:
+            return  # no retained snapshot at the stable position
+        view, cv, app, marks = snap
+        try:
+            app_digest = self.consumer.snapshot_digest(app)
+        except (ValueError, NotImplementedError):
+            return
+        if (
+            checkpoint_mod.checkpoint_digest(app_digest, count, view, cv, marks)
+            != coll.stable_digest
+        ):
+            self.log.error(
+                "local snapshot at %d diverges from the stable digest — "
+                "not persisting",
+                count,
+            )
+            return
+        state = recovery_store.StableState(
+            count=count,
+            view=view,
+            cv=cv,
+            usig_counter=self._own_ui_counter(),
+            app_state=app,
+            watermarks=tuple(marks),
+            cert=tuple(coll.stable_certificate[: self.f + 1]),
+        )
+        self._spawn_bg(self._durable_save(state))
+
+    async def _durable_save(self, state) -> None:
+        rec = self.recovery
+        try:
+            wrote = await asyncio.to_thread(rec.store.save, state)
+        except OSError as e:
+            rec.note_save_error()
+            self.metrics.inc("recovery_save_errors")
+            self.log.error("durable checkpoint save failed: %r", e)
+            return
+        if wrote:
+            rec.note_saved(state.count)
+            self.metrics.inc("recovery_saves")
+
+    async def restore_from_store(self) -> None:
+        """Crash-consistent startup restore (called by ``_Replica.start``
+        BEFORE any peer connection): load the durable stable state,
+        re-validate its f+1 certificate and recompute the composite digest
+        — the file is a cache of certified state, never an authority —
+        then install exactly like a completed state transfer.  The normal
+        broadcast-log replay delta-catches-up the tail from here, and a
+        LOG-BASE above our restored count triggers an ordinary chunked
+        fetch.  A corrupted committed file raises
+        :class:`minbft_tpu.recovery.store.CorruptStoreError` — deliberately
+        fatal (``peer run`` exits non-zero) rather than a silent fresh
+        start."""
+        rec = self.recovery
+        if rec is None or rec.store is None:
+            return
+        rec.set_phase(recovery_mod.PHASE_LOADING)
+        state = await asyncio.to_thread(rec.store.load)
+        if state is None:
+            rec.set_phase(recovery_mod.PHASE_IDLE)
+            return
+        rec.arm()
+        try:
+            target = await self.validate_checkpoint_cert(state.cert)
+        except api.AuthenticationError as e:
+            raise recovery_store.CorruptStoreError(
+                f"durable store certificate invalid: {e}"
+            )
+        if (target.count, target.view, target.cv) != (
+            state.count,
+            state.view,
+            state.cv,
+        ):
+            raise recovery_store.CorruptStoreError(
+                "durable store position does not match its certificate"
+            )
+        try:
+            app_digest = self.consumer.snapshot_digest(state.app_state)
+        except (ValueError, NotImplementedError) as e:
+            raise recovery_store.CorruptStoreError(
+                f"durable store snapshot rejected by the consumer: {e!r}"
+            )
+        composite = checkpoint_mod.checkpoint_digest(
+            app_digest, state.count, state.view, state.cv, state.watermarks
+        )
+        if composite != target.digest:
+            raise recovery_store.CorruptStoreError(
+                "durable store snapshot does not match its f+1 certificate"
+            )
+        self._adopt_cert(state.cert)
+        self.consumer.install_snapshot(state.app_state)
+        self.client_states.install_retire_watermarks(state.watermarks)
+        self.commitment_collector.install_checkpoint(state.view, state.cv)
+        self.checkpoint_emitter.install(state.count)
+        self._exec_pos = (state.view, state.cv)
+        rec.restored_count = state.count
+        rec.set_phase(recovery_mod.PHASE_CATCHUP)
+        self.metrics.inc("recovery_restores")
+        self.log.info(
+            "recovered durable state at count %d (view %d cv %d, usig "
+            "watermark %d)",
+            state.count,
+            state.view,
+            state.cv,
+            state.usig_counter,
+        )
         cur, _ = await self.view_state.hold_view()
-        if resp.view > cur:
-            await self.view_state.advance_expected_view(resp.view)
-            await self.view_state.advance_current_view(resp.view)
-        await self._maybe_apply_pending_new_view()
-        return True
+        if state.view > cur:
+            await self.view_state.advance_expected_view(state.view)
+            await self.view_state.advance_current_view(state.view)
 
     def _spawn_bg(self, coro) -> "asyncio.Task":
         """``create_task`` under the ``_bg_tasks`` retention contract
@@ -1739,6 +2140,9 @@ class Handlers:
                 LogBase,
                 SnapshotReq,
                 SnapshotResp,
+                StateReq,
+                StateChunk,
+                StateDone,
             ),
         ):
             self.metrics.inc("messages_handled")

@@ -35,6 +35,7 @@ class _Replica(api.Replica):
         timer_provider: Optional[TimerProvider] = None,
         logger: Optional[logging.Logger] = None,
         group: Optional[int] = None,
+        state_dir: Optional[str] = None,
     ):
         n, f = configer.n, configer.f
         if n < 2 * f + 1:
@@ -56,6 +57,20 @@ class _Replica(api.Replica):
             p: MessageLog() for p in range(n) if p != replica_id
         }
         client_states = ClientStates(timer_provider)
+        # Durable crash recovery (minbft_tpu.recovery): a state dir gets
+        # this replica a durable checkpoint store plus the recovery
+        # telemetry manager; without one both stay off (recovery=None).
+        recovery = None
+        if state_dir:
+            from ..recovery import DurableStore, RecoveryManager, store_path
+
+            recovery = RecoveryManager(
+                DurableStore(
+                    store_path(state_dir, replica_id, group=group), replica_id
+                ),
+                group=group,
+            )
+        self.recovery = recovery
         self.handlers = message_handling.Handlers(
             replica_id,
             n,
@@ -68,6 +83,7 @@ class _Replica(api.Replica):
             client_states,
             logger or make_logger(replica_id),
             group=group,
+            recovery=recovery,
         )
 
     @property
@@ -88,6 +104,12 @@ class _Replica(api.Replica):
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
+        # Crash-consistent restore BEFORE any connection or replay: peers
+        # must see the restored position in our HELLOs and LOG-BASE
+        # handling, and the own-log replay must start from installed
+        # state.  A corrupted store raises CorruptStoreError out of here
+        # — deliberately fatal, never a silent fresh start.
+        await self.handlers.restore_from_store()
         self._tasks.append(
             loop.create_task(
                 message_handling.run_own_message_loop(self.handlers, self._done)
@@ -185,6 +207,7 @@ def new_replica(
     logger: Optional[logging.Logger] = None,
     opts=None,
     group: Optional[int] = None,
+    state_dir: Optional[str] = None,
 ) -> api.Replica:
     """Create a replica (reference minbft.New, core/replica.go:50).
 
@@ -201,5 +224,5 @@ def new_replica(
         logger = logger or resolved.logger
     return _Replica(
         replica_id, configer, authenticator, connector, consumer,
-        timer_provider, logger, group=group,
+        timer_provider, logger, group=group, state_dir=state_dir,
     )

@@ -17,6 +17,9 @@ from ..messages import (
     Request,
     SnapshotReq,
     SnapshotResp,
+    StateChunk,
+    StateDone,
+    StateReq,
 )
 
 
@@ -34,7 +37,7 @@ def signing_role(msg: Message) -> api.AuthenticationRole:
         msg,
         (
             Reply, Busy, ReqViewChange, Checkpoint, SnapshotReq,
-            SnapshotResp, Hello,
+            SnapshotResp, StateReq, StateChunk, StateDone, Hello,
         ),
     ):
         return api.AuthenticationRole.REPLICA

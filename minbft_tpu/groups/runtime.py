@@ -775,6 +775,7 @@ class GroupRuntime(api.Replica):
         domain_separation: bool = True,
         wrap_group_connector=None,
         engine_pool=None,
+        state_dir: Optional[str] = None,
     ):
         if not authenticators:
             raise ValueError("need at least one group authenticator")
@@ -822,6 +823,9 @@ class GroupRuntime(api.Replica):
                 timer_provider,
                 logging.getLogger(f"minbft.replica{replica_id}.g{g}"),
                 group=g,
+                # store_path gives each group core its own group<g>/
+                # subdirectory under the shared state dir.
+                state_dir=state_dir,
             )
             self.cores.append(core)
         # Stale-group detector state (ISSUE 14): per-group

@@ -39,6 +39,9 @@ from .message import (
     Request,
     SnapshotReq,
     SnapshotResp,
+    StateChunk,
+    StateDone,
+    StateReq,
     ViewChange,
 )
 
@@ -181,6 +184,45 @@ def _authen_bytes(m: Message) -> bytes:
             + _U64.pack(m.view)
             + _U64.pack(m.cv)
             + _sha256(m.app_state)
+            + h.digest()
+        )
+    if isinstance(m, StateReq):
+        # The resume offset is covered (see StateReq doc): rewinding or
+        # fast-forwarding it in flight must fail verification.
+        return (
+            b"STATE-REQ"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.count)
+            + _U64.pack(m.offset)
+        )
+    if isinstance(m, StateChunk):
+        # Covers the slice position, the stream length, the data, and the
+        # running chain digest — a Byzantine responder cannot splice a
+        # validly-signed chunk of one stream into another position.
+        return (
+            b"STATE-CHUNK"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.count)
+            + _U64.pack(m.offset)
+            + _U64.pack(m.total)
+            + _sha256(m.data)
+            + _sha256(m.chain)
+        )
+    if isinstance(m, StateDone):
+        # The checkpoint certificate is deliberately NOT covered — like a
+        # VIEW-CHANGE's, it is transferable third-party evidence the
+        # receiver validates independently (any f+1 matching attestation
+        # serves).
+        h = hashlib.sha256()
+        for c, s in m.watermarks:
+            h.update(_U32.pack(c) + _U64.pack(s))
+        return (
+            b"STATE-DONE"
+            + _U32.pack(m.replica_id)
+            + _U64.pack(m.count)
+            + _U64.pack(m.view)
+            + _U64.pack(m.cv)
+            + _U64.pack(m.total)
             + h.digest()
         )
     raise TypeError(f"{type(m).__name__} has no authen bytes")

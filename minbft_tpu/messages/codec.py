@@ -40,6 +40,9 @@ from .message import (
     Request,
     SnapshotReq,
     SnapshotResp,
+    StateChunk,
+    StateDone,
+    StateReq,
     ViewChange,
 )
 
@@ -57,6 +60,9 @@ _TAG_LOG_BASE = 0x0A
 _TAG_SNAPSHOT_REQ = 0x0B
 _TAG_SNAPSHOT_RESP = 0x0C
 _TAG_BUSY = 0x0D
+_TAG_STATE_REQ = 0x0E
+_TAG_STATE_CHUNK = 0x0F
+_TAG_STATE_DONE = 0x10
 # Transport-level container: several messages coalesced into ONE stream
 # frame (amortizes the per-frame gRPC/asyncio cost, which dominates the
 # multi-process deployment's throughput on small hosts).  Deliberately far
@@ -273,6 +279,39 @@ def marshal(m: Message) -> bytes:
             + _pack_u64(m.view)
             + _pack_u64(m.cv)
             + _pack_bytes(m.app_state)
+            + _pack_u32(len(m.watermarks))
+            + b"".join(_pack_u32(c) + _pack_u64(s) for c, s in m.watermarks)
+            + _pack_u32(len(m.cert))
+            + b"".join(_pack_bytes(marshal(c)) for c in m.cert)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, StateReq):
+        return (
+            bytes([_TAG_STATE_REQ])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.count)
+            + _pack_u64(m.offset)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, StateChunk):
+        return (
+            bytes([_TAG_STATE_CHUNK])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.count)
+            + _pack_u64(m.offset)
+            + _pack_u64(m.total)
+            + _pack_bytes(m.data)
+            + _pack_bytes(m.chain)
+            + _pack_bytes(m.signature)
+        )
+    if isinstance(m, StateDone):
+        return (
+            bytes([_TAG_STATE_DONE])
+            + _pack_u32(m.replica_id)
+            + _pack_u64(m.count)
+            + _pack_u64(m.view)
+            + _pack_u64(m.cv)
+            + _pack_u64(m.total)
             + _pack_u32(len(m.watermarks))
             + b"".join(_pack_u32(c) + _pack_u64(s) for c, s in m.watermarks)
             + _pack_u32(len(m.cert))
@@ -553,6 +592,58 @@ def _unmarshal_at(data: bytes, off: int, depth: int = 0) -> Tuple[Message, int]:
                 replica_id=rid, count=count, view=view, cv=cv,
                 app_state=app, watermarks=tuple(marks), cert=tuple(cert),
                 signature=sig,
+            ),
+            off,
+        )
+    if tag == _TAG_STATE_REQ:
+        rid, off = _read_u32(data, off)
+        count, off = _read_u64(data, off)
+        soff, off = _read_u64(data, off)
+        sig, off = _read_bytes(data, off)
+        return (
+            StateReq(replica_id=rid, count=count, offset=soff, signature=sig),
+            off,
+        )
+    if tag == _TAG_STATE_CHUNK:
+        rid, off = _read_u32(data, off)
+        count, off = _read_u64(data, off)
+        soff, off = _read_u64(data, off)
+        total, off = _read_u64(data, off)
+        chunk, off = _read_bytes(data, off)
+        chain, off = _read_bytes(data, off)
+        sig, off = _read_bytes(data, off)
+        return (
+            StateChunk(
+                replica_id=rid, count=count, offset=soff, total=total,
+                data=chunk, chain=chain, signature=sig,
+            ),
+            off,
+        )
+    if tag == _TAG_STATE_DONE:
+        rid, off = _read_u32(data, off)
+        count, off = _read_u64(data, off)
+        view, off = _read_u64(data, off)
+        cv, off = _read_u64(data, off)
+        total, off = _read_u64(data, off)
+        wcount, off = _read_u32(data, off)
+        marks = []
+        for _ in range(wcount):
+            c, off = _read_u32(data, off)
+            s, off = _read_u64(data, off)
+            marks.append((c, s))
+        ccount, off = _read_u32(data, off)
+        cert = []
+        for _ in range(ccount):
+            cb, off = _read_bytes(data, off)
+            cp = unmarshal(cb, depth + 1)
+            if not isinstance(cp, Checkpoint):
+                raise CodecError("STATE-DONE cert entries must be CHECKPOINTs")
+            cert.append(cp)
+        sig, off = _read_bytes(data, off)
+        return (
+            StateDone(
+                replica_id=rid, count=count, view=view, cv=cv, total=total,
+                watermarks=tuple(marks), cert=tuple(cert), signature=sig,
             ),
             off,
         )

@@ -416,22 +416,90 @@ class SnapshotResp(Message):
     signature: bytes = b""
 
 
+@dataclasses.dataclass
+class StateReq(Message):
+    """Signed request for a **chunked** state stream starting at byte
+    ``offset`` of the snapshot at stable checkpoint ``count`` (the
+    ``Hello.resume_counter`` pattern generalized to state — ISSUE 20).
+    ``count == 0`` asks for the responder's latest stable snapshot;
+    ``offset > 0`` resumes a transfer severed mid-stream: the requester
+    stamps how many bytes it has already verified against the chunk
+    digest chain, and the responder serves only the missing tail.  The
+    offset is signed with the id, so an in-path attacker can neither
+    rewind the stream (waste) nor fast-forward it (starve the requester
+    of bytes it still needs)."""
+
+    KIND = "STATE-REQ"
+    replica_id: int
+    count: int = 0
+    offset: int = 0
+    signature: bytes = b""
+
+
+@dataclasses.dataclass
+class StateChunk(Message):
+    """One signed slice of a snapshot stream: ``data`` is the snapshot
+    bytes at ``offset`` of the ``total``-byte snapshot certified at
+    stable checkpoint ``count``.  ``chain`` is the running digest
+    ``chain_k = sha256(chain_{k-1} || data_k)`` (empty-string seed),
+    recomputed by the responder from byte 0 regardless of the resume
+    offset — chunking is deterministic (fixed chunk size), so any two
+    honest responders produce byte-identical chunks and a resumed fetch
+    can switch peers mid-stream.  The receiver extends its own chain
+    and drops the transfer on the FIRST mismatching chunk (early
+    Byzantine detection), but final authority stays with the f+1
+    checkpoint certificate the assembled snapshot is verified against
+    before install — the chain alone proves nothing."""
+
+    KIND = "STATE-CHUNK"
+    replica_id: int
+    count: int
+    offset: int
+    total: int
+    data: bytes
+    chain: bytes = b""
+    signature: bytes = b""
+
+
+@dataclasses.dataclass
+class StateDone(Message):
+    """Signed terminal frame of a chunked state stream: the protocol
+    position (view, cv) and deterministic watermarks at checkpoint
+    ``count``, with ``total`` pinning the stream length.  ``cert`` is
+    attached when the stream served a NEWER stable checkpoint than the
+    requested one (the exact snapshot aged out of the retention
+    window); the receiver validates it independently — exactly the
+    SnapshotResp upgrade rule — before accepting the new target."""
+
+    KIND = "STATE-DONE"
+    replica_id: int
+    count: int
+    view: int
+    cv: int
+    total: int
+    # Same layout as SnapshotResp.watermarks.
+    watermarks: Tuple[Tuple[int, int], ...] = ()
+    cert: Tuple[Checkpoint, ...] = ()
+    signature: bytes = b""
+
+
 # ---------------------------------------------------------------------------
 # Classification helpers (reference messages/api.go interface hierarchy).
 
 CLIENT_MESSAGES = (Request,)
 REPLICA_MESSAGES = (
     Reply, Busy, Prepare, Commit, ReqViewChange, ViewChange, NewView,
-    Checkpoint, LogBase, SnapshotReq, SnapshotResp,
+    Checkpoint, LogBase, SnapshotReq, SnapshotResp, StateReq, StateChunk,
+    StateDone,
 )
 PEER_MESSAGES = (
     Prepare, Commit, ReqViewChange, ViewChange, NewView, Checkpoint,
-    LogBase, SnapshotReq, SnapshotResp,
+    LogBase, SnapshotReq, SnapshotResp, StateReq, StateChunk, StateDone,
 )
 CERTIFIED_MESSAGES = (Prepare, Commit, ViewChange, NewView)  # carry a USIG UI
 SIGNED_MESSAGES = (
     Request, Reply, Busy, ReqViewChange, Checkpoint, SnapshotReq,
-    SnapshotResp,
+    SnapshotResp, StateReq, StateChunk, StateDone,
 )  # carry a plain signature
 
 # The kinds that may enter a per-peer UNICAST log (forwarded starved
@@ -449,7 +517,12 @@ SIGNED_MESSAGES = (
 # transfer, an unencrypted key share), the HELLO handshake must gain
 # replay protection (a challenge nonce) IN THE SAME CHANGE, or a replayed
 # HELLO becomes an exfiltration channel (ADVICE low-#2).
-UNICAST_LOG_MESSAGES = (Request, SnapshotReq, SnapshotResp)
+# The chunked state-transfer trio (ISSUE 20) satisfies the invariant the
+# same way the monolithic pair does: chunks carry slices of a snapshot
+# whose WHOLE content is certificate-backed public protocol state.
+UNICAST_LOG_MESSAGES = (
+    Request, SnapshotReq, SnapshotResp, StateReq, StateChunk, StateDone,
+)
 
 
 def is_peer_message(m: Message) -> bool:

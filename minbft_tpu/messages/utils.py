@@ -15,6 +15,9 @@ from .message import (
     Request,
     SnapshotReq,
     SnapshotResp,
+    StateChunk,
+    StateDone,
+    StateReq,
     ViewChange,
 )
 
@@ -77,5 +80,20 @@ def stringify(m: Message) -> str:
         return (
             f"<SNAPSHOT-RESP replica={m.replica_id} count={m.count} "
             f"view={m.view} cv={m.cv} state={len(m.app_state)}B>"
+        )
+    if isinstance(m, StateReq):
+        return (
+            f"<STATE-REQ replica={m.replica_id} count={m.count} "
+            f"offset={m.offset}>"
+        )
+    if isinstance(m, StateChunk):
+        return (
+            f"<STATE-CHUNK replica={m.replica_id} count={m.count} "
+            f"offset={m.offset}/{m.total} data={len(m.data)}B>"
+        )
+    if isinstance(m, StateDone):
+        return (
+            f"<STATE-DONE replica={m.replica_id} count={m.count} "
+            f"view={m.view} cv={m.cv} total={m.total} cert={len(m.cert)}>"
         )
     return f"<{type(m).__name__}>"

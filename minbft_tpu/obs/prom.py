@@ -122,6 +122,7 @@ def collect_replica(
     stall_after_s: float = 30.0,
     slo=None,
     slo_spool=None,
+    recovery=None,
 ) -> List[Family]:
     """Build the metric families for one replica process.
 
@@ -321,6 +322,127 @@ def collect_replica(
                 [slo], timeseries=timeseries, spool=slo_spool, base=base
             )
         )
+    if recovery is not None:
+        fams.extend(collect_recovery([recovery], base=base))
+    return fams
+
+
+def collect_recovery(
+    managers, base: Optional[Dict[str, str]] = None
+) -> List[Family]:
+    """Families for the crash-recovery subsystem
+    (:class:`minbft_tpu.recovery.RecoveryManager`, one per replica core):
+    the phase gauge, chunk/byte transfer counters split by direction,
+    resume/failover counts, durable-store save counters, and — once a
+    restarted replica executes its first request — the
+    ``minbft_recovery_time_ms`` SLO gauge the chaos soak gates
+    (benchgate key ``chaos_recovery_time_ms``)."""
+    base = dict(base or {})
+    fams: List[Family] = []
+
+    def lb(m, **extra):
+        out = dict(base)
+        if m.group is not None:
+            out["group"] = str(m.group)
+        out.update(extra)
+        return out
+
+    fams.append(
+        (
+            "minbft_recovery_phase",
+            "gauge",
+            "recovery phase (0=idle 1=load 2=fetch 3=install 4=catchup "
+            "5=done)",
+            [(lb(m), m.phase) for m in managers],
+        )
+    )
+    fams.append(
+        (
+            "minbft_recovery_chunks_total",
+            "counter",
+            "state-transfer chunks moved, by direction (rx=fetched and "
+            "verified, tx=served)",
+            [
+                s
+                for m in managers
+                for s in (
+                    (lb(m, dir="rx"), m.chunks_rx),
+                    (lb(m, dir="tx"), m.chunks_tx),
+                )
+            ],
+        )
+    )
+    fams.append(
+        (
+            "minbft_recovery_bytes_total",
+            "counter",
+            "state-transfer payload bytes moved, by direction",
+            [
+                s
+                for m in managers
+                for s in (
+                    (lb(m, dir="rx"), m.bytes_rx),
+                    (lb(m, dir="tx"), m.bytes_tx),
+                )
+            ],
+        )
+    )
+    fams.append(
+        (
+            "minbft_recovery_resume_total",
+            "counter",
+            "chunked transfers resumed from a verified offset after an "
+            "interruption (same source, no bytes re-downloaded)",
+            [(lb(m), m.resumes) for m in managers],
+        )
+    )
+    fams.append(
+        (
+            "minbft_recovery_failover_total",
+            "counter",
+            "chunked transfers failed over to another source (stalled or "
+            "Byzantine-corrupt stream)",
+            [(lb(m), m.failovers) for m in managers],
+        )
+    )
+    fams.append(
+        (
+            "minbft_recovery_saves_total",
+            "counter",
+            "durable checkpoint saves committed (atomic write-rename)",
+            [(lb(m), m.saves) for m in managers],
+        )
+    )
+    restored = [
+        (lb(m), m.restored_count)
+        for m in managers
+        if m.restored_count is not None
+    ]
+    if restored:
+        fams.append(
+            (
+                "minbft_recovery_restored_count",
+                "gauge",
+                "stable execution count restored from the durable store "
+                "at startup",
+                restored,
+            )
+        )
+    times = [
+        (lb(m), round(m.recovery_time_ms, 3))
+        for m in managers
+        if m.recovery_time_ms is not None
+    ]
+    if times:
+        fams.append(
+            (
+                "minbft_recovery_time_ms",
+                "gauge",
+                "restart-to-first-executed-request time (the recovery SLO "
+                "the chaos soak gates as chaos_recovery_time_ms)",
+                times,
+            )
+        )
     return fams
 
 
@@ -509,6 +631,15 @@ def collect_group_runtime(runtime, engine=None, replica_id=None,
                 base=base,
             )
         )
+    # One collect_recovery across every core's manager: each carries its
+    # own group label (like the SLO ledgers).
+    recovery_managers = [
+        core.recovery for core in runtime.cores
+        if getattr(core, "recovery", None) is not None
+    ]
+    if recovery_managers:
+        base = {} if replica_id is None else {"replica": str(replica_id)}
+        lists.append(collect_recovery(recovery_managers, base=base))
     fams = merge_family_lists(lists)
     if engine_pool is None:
         engine_pool = getattr(runtime, "engine_pool", None)

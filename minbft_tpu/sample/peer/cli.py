@@ -44,7 +44,7 @@ def _env(name: str, fallback, choices=None):
 _PEER_OPTION_SCHEMA = {
     None: {"keys", "config", "log_level", "log_file", "auth", "transport"},
     "run": {"listen", "batch", "metrics_interval", "metrics_port",
-            "metrics_host", "groups", "chips"},
+            "metrics_host", "groups", "chips", "state_dir"},
     "request": {"client_id", "timeout", "group"},
 }
 
@@ -241,6 +241,18 @@ def build_parser(options: dict | None = None) -> argparse.ArgumentParser:
         "recover it; 0 = off (default).  Size it well above the "
         "checkpoint/view-change cadence — a healthy broadcast-log "
         "stream is never legitimately idle for long.",
+    )
+    r.add_argument(
+        "--state-dir",
+        default=_opt("state_dir", "", section="run"),
+        help="durable crash-recovery store directory (minbft_tpu/"
+        "recovery): every stable checkpoint is persisted atomically "
+        "(write-to-temp + fsync + rename) and reloaded at startup, so a "
+        "SIGKILLed replica resumes from its last stable count instead "
+        "of a cold state fetch.  MINBFT_STATE_DIR is the env "
+        "equivalent; empty (default) = no durability.  A corrupted "
+        "committed store file is FATAL at startup (rc!=0) — silent "
+        "acceptance of tampered state is worse than refusing to serve.",
     )
 
     m = sub.add_parser(
@@ -569,6 +581,37 @@ async def _run_replica(args) -> int:
     for rid, addr in addrs.items():
         if rid != args.id:
             conn.connect_replica(rid, addr)
+
+    # Env-gated chaos wrap (MINBFT_CHAOS_SEED): this replica's OUTBOUND
+    # peer traffic flows through the seeded fault-injection network —
+    # the real-process face of `selftest --chaos-seed`.  Sender-side
+    # injection covers every directed link when all replicas run with
+    # the seed (each owns its outgoing edges); the census rides the
+    # /metrics exposition so a soak can assert the replayed schedule.
+    # MINBFT_CHAOS_PLAN names a profile ("lossy") or inline
+    # probabilities ("drop=0.02,reset=0.01").
+    chaos_net = None
+    if os.environ.get("MINBFT_CHAOS_SEED"):
+        from ...testing import FaultNet, chaos_seed, plan_from_spec
+
+        run_chaos_seed = chaos_seed()
+        plan_spec = os.environ.get("MINBFT_CHAOS_PLAN", "lossy")
+        chaos_net = FaultNet(
+            seed=run_chaos_seed, default_plan=plan_from_spec(plan_spec)
+        )
+        conn = chaos_net.wrap(conn, f"r{args.id}")
+        print(
+            f"replica {args.id} chaos: seed={run_chaos_seed:#x} "
+            f"plan={plan_spec} (outbound links)",
+            file=sys.stderr,
+        )
+
+    # Durable crash-recovery store (minbft_tpu/recovery): flag wins,
+    # then MINBFT_STATE_DIR; empty = no durability (today's behaviour).
+    from ...recovery import CorruptStoreError, state_dir_from_env
+
+    state_dir = getattr(args, "state_dir", "") or state_dir_from_env()
+
     n_groups = args.groups if args.groups > 0 else getattr(cfg, "groups", 1)
     grouped = n_groups > 1
     engine_pool = None
@@ -612,17 +655,35 @@ async def _run_replica(args) -> int:
             [SimpleLedger() for _ in range(n_groups)],
             logger=ropts.logger,
             engine_pool=engine_pool,
+            state_dir=state_dir or None,
         )
     else:
         ledger = SimpleLedger()
         replica = new_replica(
-            args.id, cfg, make_auth(), conn, ledger, opts=_log_opts(args)
+            args.id, cfg, make_auth(), conn, ledger, opts=_log_opts(args),
+            state_dir=state_dir or None,
         )
     server = ReplicaServer(replica)
     listen = args.listen or addrs[args.id]
     bound = await server.start(listen)
     print(f"replica {args.id} serving on {bound}", file=sys.stderr)
-    await replica.start()
+    try:
+        await replica.start()
+    except CorruptStoreError as e:
+        # A committed store file that fails its own integrity or
+        # certificate check is a hard startup refusal, not a warning: a
+        # replica serving silently-wrong state is the one failure a BFT
+        # deployment cannot tolerate.  The operator clears or restores
+        # the state dir deliberately.
+        print(
+            f"peer: FATAL: replica {args.id} durable state store is "
+            f"corrupt — refusing to serve: {e}\n"
+            f"peer: clear or restore the --state-dir contents to recover",
+            file=sys.stderr,
+        )
+        await server.stop()
+        await conn.close()
+        return 4
 
     from ...obs import trace as obs_trace
 
@@ -692,29 +753,36 @@ async def _run_replica(args) -> int:
                 # stats carry c{chip}:-prefixed queue names, and the
                 # runtime's engine_pool adds the minbft_engine_pool_*
                 # per-chip families.
-                return obs_prom.render_families(
-                    obs_prom.collect_group_runtime(
-                        replica,
-                        engine=engine if engine is not None else engine_pool,
-                        replica_id=args.id,
-                        timeseries=tseries,
-                        slo_spool=slo_spool,
-                    )
+                fams = obs_prom.collect_group_runtime(
+                    replica,
+                    engine=engine if engine is not None else engine_pool,
+                    replica_id=args.id,
+                    timeseries=tseries,
+                    slo_spool=slo_spool,
                 )
+                if chaos_net is not None:
+                    fams.extend(obs_prom.collect_faultnet(
+                        chaos_net.census, base={"replica": str(args.id)}
+                    ))
+                return obs_prom.render_families(fams)
 
         else:
             def render() -> str:
-                return obs_prom.render_families(
-                    obs_prom.collect_replica(
-                        metrics=replica.metrics,
-                        recorder=replica.handlers.trace,
-                        engine=engine,
-                        replica_id=args.id,
-                        timeseries=tseries,
-                        slo=slo_ledgers[0] if slo_ledgers else None,
-                        slo_spool=slo_spool,
-                    )
+                fams = obs_prom.collect_replica(
+                    metrics=replica.metrics,
+                    recorder=replica.handlers.trace,
+                    engine=engine,
+                    replica_id=args.id,
+                    timeseries=tseries,
+                    slo=slo_ledgers[0] if slo_ledgers else None,
+                    slo_spool=slo_spool,
+                    recovery=getattr(replica, "recovery", None),
                 )
+                if chaos_net is not None:
+                    fams.extend(obs_prom.collect_faultnet(
+                        chaos_net.census, base={"replica": str(args.id)}
+                    ))
+                return obs_prom.render_families(fams)
 
         metrics_server = obs_prom.MetricsServer(
             render, host=args.metrics_host, port=args.metrics_port
@@ -1482,6 +1550,9 @@ def _scrape_top_state(addr: str, timeout: float) -> dict:
         "stall": by_identity("minbft_health_commit_stall"),
         "stale": by_identity("minbft_health_stale_group"),
         "vchanges": by_identity("minbft_view_changes_completed_total"),
+        # Crash-recovery phase (minbft_tpu/recovery): absent on targets
+        # running without a durable store — the console renders "-".
+        "recov": by_identity("minbft_recovery_phase"),
         "build": {},
         "depth": total("minbft_verify_queue_depth")
         + total("minbft_sign_queue_depth"),
@@ -1543,10 +1614,12 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
     identity per target, DOWN rows for unreachable targets.  Returns
     ``(lines, unhealthy)`` — unhealthy when any row flags a commit
     stall or stale group (the --stall-flag exit hook)."""
+    from ...recovery import PHASE_NAMES
+
     lines = [
         f"{'TARGET':<24}{'R':>3}{'G':>3}{'REQ/S':>9}{'SHED/S':>8}"
         f"{'FILL':>7}{'UTIL%':>7}{'DEPTH':>7}{'PEAK':>6}{'LAG_MS':>8}"
-        f"{'BURN':>6}{'BUDG':>6}{'VIEW':>5}  HEALTH"
+        f"{'BURN':>6}{'BUDG':>6}{'VIEW':>5}{'RECOV':>8}  HEALTH"
     ]
     unhealthy = False
     for addr in sorted(set(states) | set(errors)):
@@ -1637,11 +1710,22 @@ def _top_frame(states: dict, errors: dict, prev: dict) -> "tuple[list, bool]":
             if vc:
                 flags.append(f"vc={int(vc)}")
             view = int(st["view"].get(ident, 0))
+            # RECOV: the durable-store recovery phase by short name; a
+            # replica stuck in "fetch"/"install" long after restart is
+            # the console's first visible symptom of a wedged transfer.
+            ph = st.get("recov", {}).get(ident)
+            if ph is None:
+                recov_s = "-"
+            else:
+                pi = int(ph)
+                recov_s = (
+                    PHASE_NAMES[pi] if 0 <= pi < len(PHASE_NAMES) else str(pi)
+                )
             lines.append(
                 f"{addr:<24}{rid:>3}{grp:>3}{rps:>9.1f}{shed_rate:>8.1f}"
                 f"{fill:>7.1f}{min(util, 999.0):>7.1f}{st['depth']:>7.0f}"
                 f"{st['peak']:>6.0f}{lag:>8.2f}{burn_s:>6}{budg_s:>6}"
-                f"{view:>5}  {' '.join(flags) or 'ok'}"
+                f"{view:>5}{recov_s:>8}  {' '.join(flags) or 'ok'}"
             )
             # Engine-pool expansion (ISSUE 17): the group's home chip as
             # a sub-row.  A chip the scrape knows nothing about (or one

@@ -20,6 +20,7 @@ Three modules, usable from tests AND from the ``peer selftest
 """
 
 from .faultnet import (
+    CHAOS_PLAN_ENV,
     CHAOS_SEED_ENV,
     PROFILES,
     FaultCensus,
@@ -27,11 +28,18 @@ from .faultnet import (
     FaultPlan,
     FaultyConnectionHandler,
     FaultyConnector,
+    ProcessChaos,
     chaos_seed,
+    plan_from_spec,
 )
-from .invariants import InvariantChecker, InvariantViolation
+from .invariants import (
+    InvariantChecker,
+    InvariantViolation,
+    RecoveryInvariantChecker,
+)
 
 __all__ = [
+    "CHAOS_PLAN_ENV",
     "CHAOS_SEED_ENV",
     "PROFILES",
     "FaultCensus",
@@ -41,5 +49,8 @@ __all__ = [
     "FaultyConnector",
     "InvariantChecker",
     "InvariantViolation",
+    "ProcessChaos",
+    "RecoveryInvariantChecker",
     "chaos_seed",
+    "plan_from_spec",
 ]

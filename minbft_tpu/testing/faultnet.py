@@ -105,6 +105,48 @@ PROFILES: Dict[str, FaultPlan] = {
     "slow": FaultPlan(delay=0.6, delay_s=(0.005, 0.05)),
 }
 
+#: ``peer run`` chaos-plan override (with ``MINBFT_CHAOS_SEED`` set):
+#: a profile name from PROFILES or inline ``kind=prob`` pairs.
+CHAOS_PLAN_ENV = "MINBFT_CHAOS_PLAN"
+
+
+def plan_from_spec(spec: str) -> FaultPlan:
+    """Parse a chaos-plan spec: a PROFILES name (``"lossy"``) or inline
+    comma-separated probabilities (``"drop=0.02,reset=0.01"``).  The
+    inline form accepts exactly the seeded FaultPlan fields — an unknown
+    kind or a non-numeric value fails loudly (a typo silently yielding
+    the all-zero plan would make a chaos soak vacuous)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return PROFILES["lossy"]
+    if spec in PROFILES:
+        return PROFILES[spec]
+    if "=" not in spec:
+        raise ValueError(
+            f"unknown chaos plan {spec!r}: not a profile "
+            f"({', '.join(sorted(PROFILES))}) and not kind=prob pairs"
+        )
+    kw: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, val = part.partition("=")
+        kind = kind.strip()
+        if kind not in SEEDED_KINDS:
+            raise ValueError(
+                f"unknown chaos fault kind {kind!r} in plan {spec!r} "
+                f"(choose from {', '.join(SEEDED_KINDS)})"
+            )
+        try:
+            kw[kind] = float(val)
+        except ValueError:
+            raise ValueError(
+                f"bad probability for {kind!r} in chaos plan {spec!r}: "
+                f"{val!r}"
+            ) from None
+    return FaultPlan(**kw)
+
 
 class FaultCensus:
     """Counters of injected faults, shaped for the Prometheus exposition
@@ -569,3 +611,76 @@ class FaultyConnectionHandler(api.ConnectionHandler):
             "client",
             self._endpoint,
         )
+
+
+class ProcessChaos:
+    """SIGKILL + restart chaos for real-OS-process clusters.
+
+    The in-process :class:`FaultNet` injects NETWORK faults; this is its
+    PROCESS sibling for deployments made of real ``peer run`` processes
+    (tests/test_process_cluster.py, the recovery soak): registered
+    targets are killed with SIGKILL — no graceful close on any stream,
+    no atexit, exactly a machine reset — and restarted through the same
+    spawn factory.  Kills and restarts are censused under the scripted
+    kinds ("crash"/"restart"), so a soak's fault history reads out of
+    the same :class:`FaultCensus` surface as the network faults.
+
+    Not seeded: kill timing is wall-clock by nature (the operator or
+    the soak script decides WHEN); determinism in a recovery soak comes
+    from the load schedule's seed and the durable store's contents, not
+    from the kill instant.
+    """
+
+    def __init__(self, census: Optional[FaultCensus] = None):
+        self.census = census or FaultCensus()
+        self._procs: Dict[str, object] = {}
+        self._spawn: Dict[str, object] = {}
+
+    def manage(self, name: str, spawn, proc=None):
+        """Register a target: ``spawn()`` must return a started
+        ``subprocess.Popen``-alike (``kill``/``wait``/``poll``).  Pass
+        ``proc`` when the first incarnation is already running;
+        otherwise the factory is invoked once, immediately."""
+        self._spawn[name] = spawn
+        self._procs[name] = proc if proc is not None else spawn()
+        return self._procs[name]
+
+    def proc(self, name: str):
+        return self._procs[name]
+
+    def alive(self, name: str) -> bool:
+        p = self._procs.get(name)
+        return p is not None and p.poll() is None
+
+    def kill(self, name: str, wait: float = 10.0):
+        """SIGKILL the target and reap it.  Idempotent on an already-
+        dead process (the census records the intent either way — a soak
+        script's kill is a fault even if the target beat it to dying)."""
+        p = self._procs[name]
+        p.kill()
+        p.wait(timeout=wait)
+        self.census.inc("crash", link=(name, name))
+        return p
+
+    def restart(self, name: str):
+        """Respawn a killed target through its registered factory."""
+        self._procs[name] = self._spawn[name]()
+        self.census.inc("restart", link=(name, name))
+        return self._procs[name]
+
+    def kill_restart(self, name: str, wait: float = 10.0):
+        """The canonical crash-recovery event: SIGKILL, reap, respawn."""
+        self.kill(name, wait=wait)
+        return self.restart(name)
+
+    def terminate_all(self, wait: float = 10.0) -> None:
+        """Teardown helper: TERM every live target, escalate to KILL on
+        a hung wait.  Never censused — shutdown is not a fault."""
+        for p in self._procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs.values():
+            try:
+                p.wait(timeout=wait)
+            except Exception:  # noqa: BLE001 - teardown must reach kill
+                p.kill()

@@ -171,3 +171,143 @@ class InvariantChecker:
             ],
             "accepted_checked": len(accepted),
         }
+
+
+class RecoveryInvariantChecker:
+    """Durable-store invariants for crash-recovery soaks (ISSUE 20).
+
+    :class:`InvariantChecker` above reaches into in-process replica
+    objects; a recovery soak runs REAL ``peer run`` processes, so its
+    safety surface is what survives a SIGKILL: the on-disk durable
+    stores (minbft_tpu/recovery).  Checked per store and across stores:
+
+    1. **Store self-consistency** — the committed file decodes (torn or
+       tampered bytes are an InvariantViolation, mirroring the fatal
+       startup refusal), carries a structurally valid f+1 certificate
+       (distinct claimants, all claims matching on position + digest),
+       and the persisted snapshot + watermarks RECOMPUTE to exactly the
+       certified composite digest — the store can never testify to
+       state it does not actually hold.
+    2. **Durable monotonicity** — a replica's persisted stable count
+       and USIG watermark never move backwards across repeated checks
+       (i.e. across kill/restart cycles): crash-recovery must not
+       un-happen progress the cluster certified.
+    3. **No checkpoint fork** — any two stores claiming the same stable
+       count carry the same certified digest.
+
+    Signature VALIDITY is deliberately out of scope here (the live
+    ``restore_from_store`` path re-verifies every cert signature through
+    the real authenticator); this checker is the offline, between-kills
+    view of the same evidence.
+    """
+
+    def __init__(self, f: int, digest_fn=None):
+        self._f = f
+        if digest_fn is None:
+            from ..sample.requestconsumer import SimpleLedger
+
+            digest_fn = SimpleLedger().snapshot_digest
+        self._digest_fn = digest_fn
+        # replica_id -> (count, usig) high-water marks across checks.
+        self._prev: Dict[int, Tuple[int, int]] = {}
+        # stable count -> (digest, claiming replica) across ALL checks.
+        self._digests: Dict[int, Tuple[bytes, int]] = {}
+
+    def check_store(self, path: str, replica_id: int) -> Optional[dict]:
+        """Validate one replica's durable store file; returns a summary
+        dict, or None when the file does not exist yet (a replica that
+        has not reached its first stable checkpoint has nothing durable
+        to hold to the bar)."""
+        import os as _os
+
+        from ..core.checkpoint import checkpoint_digest
+        from ..recovery import CorruptStoreError, DurableStore
+
+        if not _os.path.exists(path):
+            return None
+        try:
+            state = DurableStore(path, replica_id).load()
+        except CorruptStoreError as e:
+            raise InvariantViolation(
+                f"replica {replica_id}: durable store {path} is corrupt: {e}"
+            ) from e
+        if state is None:
+            return None
+
+        cert = state.cert
+        if len(cert) < self._f + 1:
+            raise InvariantViolation(
+                f"replica {replica_id}: durable cert has {len(cert)} "
+                f"claims, needs f+1={self._f + 1}"
+            )
+        claimants = {c.replica_id for c in cert}
+        if len(claimants) != len(cert):
+            raise InvariantViolation(
+                f"replica {replica_id}: durable cert has duplicate "
+                f"claimants {sorted(c.replica_id for c in cert)}"
+            )
+        claim = (cert[0].count, cert[0].view, cert[0].cv, cert[0].digest)
+        for c in cert[1:]:
+            if (c.count, c.view, c.cv, c.digest) != claim:
+                raise InvariantViolation(
+                    f"replica {replica_id}: durable cert claims disagree"
+                )
+        if claim[:3] != (state.count, state.view, state.cv):
+            raise InvariantViolation(
+                f"replica {replica_id}: durable position "
+                f"{(state.count, state.view, state.cv)} does not match "
+                f"its certificate {claim[:3]}"
+            )
+        composite = checkpoint_digest(
+            self._digest_fn(state.app_state),
+            state.count, state.view, state.cv, state.watermarks,
+        )
+        if composite != cert[0].digest:
+            raise InvariantViolation(
+                f"replica {replica_id}: persisted snapshot at count "
+                f"{state.count} recomputes to {composite.hex()[:12]}, "
+                f"cert says {cert[0].digest.hex()[:12]}"
+            )
+
+        prev = self._prev.get(replica_id)
+        if prev is not None:
+            if state.count < prev[0]:
+                raise InvariantViolation(
+                    f"replica {replica_id}: durable stable count moved "
+                    f"backwards ({prev[0]} -> {state.count})"
+                )
+            if state.count == prev[0] and state.usig_counter < prev[1]:
+                raise InvariantViolation(
+                    f"replica {replica_id}: durable USIG watermark moved "
+                    f"backwards at count {state.count} "
+                    f"({prev[1]} -> {state.usig_counter})"
+                )
+        self._prev[replica_id] = (state.count, state.usig_counter)
+
+        seen = self._digests.get(state.count)
+        if seen is not None and seen[0] != cert[0].digest:
+            raise InvariantViolation(
+                f"checkpoint fork at stable count {state.count}: replica "
+                f"{replica_id} certifies {cert[0].digest.hex()[:12]}, "
+                f"replica {seen[1]} certified {seen[0].hex()[:12]}"
+            )
+        self._digests.setdefault(state.count, (cert[0].digest, replica_id))
+
+        return {
+            "replica": replica_id,
+            "count": state.count,
+            "view": state.view,
+            "cv": state.cv,
+            "usig": state.usig_counter,
+            "cert": len(cert),
+        }
+
+    def check_all(self, paths: Dict[int, str]) -> dict:
+        """Check every registered store; returns a per-replica summary
+        (missing stores excluded)."""
+        out = {}
+        for replica_id, path in sorted(paths.items()):
+            summary = self.check_store(path, replica_id)
+            if summary is not None:
+                out[replica_id] = summary
+        return out

@@ -412,3 +412,101 @@ def test_finality_keys_respect_backend_refusal(tmp_path):
     assert benchgate_cli.main(
         ["--baseline", str(base_p), "--candidate", str(cand_p)]
     ) == 2
+
+
+def test_recovery_time_is_gated_on_increase():
+    """ISSUE 20: the recovery-time SLO (chaos_recovery_time_ms, kill-to-
+    first-executed) gates on INCREASE with the wide latency floor — a
+    recovery that takes 4x longer regresses; 2x is within the floor."""
+    base = _artifact(100.0, chaos_recovery_time_ms=3000.0)
+    worse = _artifact(100.0, chaos_recovery_time_ms=12000.0)  # 4x
+    report = benchgate.compare(base, worse)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["chaos_recovery_time"].status == "regression"
+    assert by_key["chaos_recovery_time"].direction == "increase"
+    assert by_key["chaos_recovery_time"].drop == pytest.approx(9000.0)
+    # 2x sits inside the default 1.5x-increase floor: tolerated
+    assert benchgate.compare(
+        base, _artifact(100.0, chaos_recovery_time_ms=6000.0)
+    ).ok
+    assert {r.key: r.status for r in benchgate.compare(
+        base, _artifact(100.0, chaos_recovery_time_ms=500.0)
+    ).results}["chaos_recovery_time"] == "improved"
+    # the latency floor stays independently tunable
+    assert not benchgate.compare(
+        base, _artifact(100.0, chaos_recovery_time_ms=6000.0),
+        lat_rel_floor=0.5,
+    ).ok
+
+
+def test_recovery_goodput_is_gated_on_drop():
+    """Under-recovery goodput (whole-run rate INCLUDING the outage
+    window) gates on DROP like any throughput headline."""
+    base = _artifact(100.0, chaos_recovery_goodput_per_sec=50.0)
+    cand = _artifact(100.0, chaos_recovery_goodput_per_sec=10.0)  # -80%
+    report = benchgate.compare(base, cand)
+    by_key = {r.key: r for r in report.results}
+    assert by_key["chaos_recovery_goodput"].status == "regression"
+    assert by_key["chaos_recovery_goodput"].direction == "drop"
+    # inside the 30% floor: noise
+    assert benchgate.compare(
+        base, _artifact(100.0, chaos_recovery_goodput_per_sec=40.0)
+    ).ok
+
+
+def test_recovery_keys_are_exact_matches_no_namespace_leak():
+    """The recovery keys are EXACT matches: lookalike *_time_ms /
+    *recovery* keys never join the gate."""
+    base = _artifact(
+        100.0,
+        foo_recovery_time_ms=5.0,  # not the exact key
+        chaos_recovery_time_total_ms=5.0,  # suffix lookalike
+        recovery_goodput_per_sec=9.0,  # missing the chaos_ prefix
+    )
+    cand = dict(base)
+    cand["foo_recovery_time_ms"] = 50000.0
+    cand["chaos_recovery_time_total_ms"] = 50000.0
+    cand["recovery_goodput_per_sec"] = 0.01
+    report = benchgate.compare(base, cand)
+    assert [r.key for r in report.results] == ["e2e"]
+    assert report.ok
+
+
+def test_recovery_keys_respect_backend_refusal(tmp_path):
+    """Cross-backend refusal covers the recovery family: rc 2 before a
+    single recovery number is read."""
+    tpu_base = _artifact(
+        1000.0, backend="tpu", tpu_unavailable=False,
+        chaos_recovery_time_ms=200.0,
+        chaos_recovery_goodput_per_sec=5000.0,
+    )
+    cpu_cand = _artifact(
+        5.0, chaos_recovery_time_ms=9000.0,
+        chaos_recovery_goodput_per_sec=5.0,
+    )
+    with pytest.raises(BackendMismatch):
+        benchgate.compare(tpu_base, cpu_cand)
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(tpu_base))
+    cand_p.write_text(json.dumps(cpu_cand))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 2
+
+
+def test_cli_injected_recovery_regression_exits_1(tmp_path, capsys):
+    """Gate liveness: a 4x recovery-time wedge flips the CLI to rc 1
+    even when every throughput key holds."""
+    base_p = tmp_path / "base.json"
+    cand_p = tmp_path / "cand.json"
+    base_p.write_text(json.dumps(_artifact(
+        100.0, chaos_recovery_time_ms=3000.0
+    )))
+    cand_p.write_text(json.dumps(_artifact(
+        100.0, chaos_recovery_time_ms=12000.0
+    )))
+    assert benchgate_cli.main(
+        ["--baseline", str(base_p), "--candidate", str(cand_p)]
+    ) == 1
+    assert "chaos_recovery_time" in capsys.readouterr().out

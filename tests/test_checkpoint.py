@@ -186,3 +186,116 @@ def test_cluster_reaches_stable_checkpoints():
         return True
 
     assert asyncio.run(scenario())
+
+
+def test_cert_validator_rejects_duplicate_claimants():
+    """ISSUE 20 edge: f+1 claims must come from DISTINCT replicas — one
+    replica signing twice is one claimant, and a Byzantine claimant
+    padding a certificate with its own replays must not reach quorum."""
+    from minbft_tpu import api
+    from minbft_tpu.core.checkpoint import make_cert_validator
+
+    async def scenario():
+        async def verify(cp):
+            return None
+
+        validate = make_cert_validator(1, verify)
+        good = (_cp(0, 4), _cp(1, 4))
+        assert (await validate(good)).count == 4
+        dup = (_cp(0, 4), _cp(0, 4))
+        try:
+            await validate(dup)
+        except api.AuthenticationError as e:
+            assert "duplicate claimants" in str(e)
+        else:
+            raise AssertionError("duplicate claimants accepted")
+        # short certificate: f claims are never enough
+        try:
+            await validate((_cp(0, 4),))
+        except api.AuthenticationError as e:
+            assert "f+1" in str(e)
+        else:
+            raise AssertionError("f-sized certificate accepted")
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_cert_validator_rejects_one_mismatched_digest():
+    """f matching claims + 1 claim diverging in ANY position field
+    (digest, count, view, or cv) invalidate the whole certificate — a
+    near-quorum must never round up."""
+    from minbft_tpu import api
+    from minbft_tpu.core.checkpoint import make_cert_validator
+
+    async def scenario():
+        async def verify(cp):
+            return None
+
+        validate = make_cert_validator(1, verify)
+        for bad in (
+            _cp(1, 4, digest=b"X" * 32),
+            _cp(1, 8),
+            _cp(1, 4, cv=9),
+            _cp(1, 4, view=2),
+        ):
+            try:
+                await validate((_cp(0, 4), bad))
+            except api.AuthenticationError as e:
+                assert "do not match" in str(e)
+            else:
+                raise AssertionError(f"mismatched claim accepted: {bad}")
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_cert_validator_surfaces_signature_failure():
+    """Every member's signature is verified — one forged claim in an
+    otherwise matching certificate kills it."""
+    from minbft_tpu import api
+    from minbft_tpu.core.checkpoint import make_cert_validator
+
+    async def scenario():
+        async def verify(cp):
+            if cp.replica_id == 1:
+                raise api.AuthenticationError("forged claim")
+            return None
+
+        validate = make_cert_validator(1, verify)
+        try:
+            await validate((_cp(0, 4), _cp(1, 4)))
+        except api.AuthenticationError as e:
+            assert "forged" in str(e)
+        else:
+            raise AssertionError("forged member signature accepted")
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_collector_install_refuses_non_dominating_cert():
+    """CheckpointCollector.install adopts an external certificate only
+    when it is AHEAD of the local stable watermark: an equal or older
+    cert (e.g. replayed from a lagging peer's LOG-BASE) must not replace
+    the richer certificate already collected, nor churn cert_version."""
+    col = CheckpointCollector(f=1)
+    col.record(_cp(0, 8))
+    col.record(_cp(1, 8))
+    col.record(_cp(2, 8))  # late claim grows the certificate to 3
+    assert col.stable_count == 8 and len(col.stable_certificate) == 3
+    v = col.cert_version
+    # same count: refused even though the incoming cert is valid
+    col.install([_cp(1, 8), _cp(3, 8)])
+    assert len(col.stable_certificate) == 3 and col.cert_version == v
+    # older count: refused outright
+    col.install([_cp(1, 4), _cp(3, 4)])
+    assert col.stable_count == 8 and col.cert_version == v
+    # empty cert: no-op, never a crash
+    col.install([])
+    assert col.stable_count == 8
+    # genuinely newer: adopted wholesale
+    col.install([_cp(1, 12), _cp(3, 12)])
+    assert col.stable_count == 12
+    assert {c.replica_id for c in col.stable_certificate} == {1, 3}
+    assert col.cert_version == v + 1

@@ -479,13 +479,17 @@ def test_killed_replica_rejoins_after_restart(tmp_path):
     )
     assert scaffold.returncode == 0, scaffold.stderr
 
-    replicas = {}
+    # ProcessChaos (testing/faultnet.py): the SIGKILL + respawn chaos
+    # helper — kills/restarts are censused like any other injected fault.
+    from minbft_tpu.testing import ProcessChaos
+
+    chaos = ProcessChaos()
     logs = []
 
     def start_replica(i):
         log = open(f"{d}/replica{i}.log", "ab")
         logs.append(log)
-        replicas[i] = subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-m", "minbft_tpu.sample.peer",
              "--keys", f"{d}/keys.yaml", "--config", f"{d}/consensus.yaml",
              "--transport", "tcp", "run", str(i), "--no-batch"],
@@ -503,7 +507,7 @@ def test_killed_replica_rejoins_after_restart(tmp_path):
 
     try:
         for i in range(4):
-            start_replica(i)
+            chaos.manage(f"r{i}", lambda i=i: start_replica(i))
         assert _wait_ports([base_port + i for i in range(4)]), "never bound"
 
         req("before-kill")
@@ -513,11 +517,10 @@ def test_killed_replica_rejoins_after_restart(tmp_path):
         survivor_logs = [f"{d}/replica{i}.log" for i in range(3)]
         pre_kill = [os.path.getsize(p) for p in survivor_logs]
 
-        replicas[3].kill()  # SIGKILL: no graceful close on any stream
-        replicas[3].wait(timeout=10)
+        chaos.kill("r3")  # SIGKILL: no graceful close on any stream
         req("while-down")  # 3/4 still commits
 
-        start_replica(3)
+        chaos.restart("r3")
         assert _wait_ports([base_port + 3]), "restarted replica never bound"
 
         # every survivor's ESTABLISHED stream to 3 died at the kill and
@@ -538,18 +541,14 @@ def test_killed_replica_rejoins_after_restart(tmp_path):
         # ladder caps at 10s: give every survivor time to re-establish,
         # then make the restarted replica LOAD-BEARING for the quorum
         time.sleep(12)
-        replicas[2].kill()
-        replicas[2].wait(timeout=10)
+        chaos.kill("r2")
         req("rejoined-load-bearing", timeout=150)
+
+        # the chaos helper censused every scripted fault
+        counts = chaos.census.snapshot()["counters"]
+        assert counts == {"crash": 2, "restart": 1}, counts
     finally:
-        for p in replicas.values():
-            if p.poll() is None:
-                p.terminate()
-        for p in replicas.values():
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        chaos.terminate_all()
         for log in logs:
             log.close()
 

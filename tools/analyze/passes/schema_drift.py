@@ -8,7 +8,9 @@ updated").  The pass extracts, statically:
    ``*`` (``f"{prefix}_req_per_sec_mean"`` -> ``*_req_per_sec_mean``);
 2. GATED families — the module-level ``_*_SUFFIX``/``_*_PREFIX``
    string constants in ``tools/benchgate`` (LOAD-named suffixes
-   combine with the LOAD prefix: ``load_*_p99_ms``);
+   combine with the LOAD prefix: ``load_*_p99_ms``), plus ``_*_KEY``
+   constants taken verbatim as exact-match patterns (the recovery
+   headlines gate on whole key names, not suffix rules);
 3. DOC'D families — the ``bench.py`` module docstring's "Extras
    schema" section (2-space-indented key-spec lines; ``/``- and
    ``,``-separated alternatives; leading-underscore tokens attach to
@@ -44,7 +46,7 @@ from ..core import Finding, Pass, Project, register_pass
 
 _TOKEN_RE = re.compile(r"^[A-Za-z_{*][A-Za-z0-9_{},*]*$")
 _PATTERN_RE = re.compile(r"^[a-z0-9_*]+$")
-_GATE_NAME_RE = re.compile(r"^_[A-Z0-9_]*?(SUFFIX|PREFIX)$")
+_GATE_NAME_RE = re.compile(r"^_[A-Z0-9_]*?(SUFFIX|PREFIX|KEY)$")
 _EXPO_SUFFIXES = ("_bucket", "_count", "_sum")
 
 
@@ -235,6 +237,7 @@ class SchemaDriftPass(Pass):
         tree = project.tree(cfg.benchgate_module)
         suffixes: List[Tuple[str, str, int]] = []  # (const name, value, line)
         prefixes: Dict[str, str] = {}
+        exacts: List[Tuple[str, int]] = []  # _*_KEY constants, verbatim
         for node in tree.body:
             if not (
                 isinstance(node, ast.Assign)
@@ -249,9 +252,13 @@ class SchemaDriftPass(Pass):
                 continue
             if cname.endswith("PREFIX"):
                 prefixes[cname] = node.value.value
+            elif cname.endswith("KEY"):
+                exacts.append((node.value.value, node.lineno))
             else:
                 suffixes.append((cname, node.value.value, node.lineno))
         out: Dict[str, int] = {}
+        for value, line in exacts:
+            out.setdefault(value, line)
         for cname, value, line in suffixes:
             prefix = ""
             for pname, pvalue in prefixes.items():

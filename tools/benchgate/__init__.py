@@ -20,6 +20,12 @@ Scope — deliberately narrow and honest:
   embedded per-point curves (``groups{G}x{C}_load_*``, ISSUE 17) join
   the same two rules, and its pool-aggregate
   ``groups{G}x{C}_util_effective_per_sec`` rides the utilization rule.
+  The crash-recovery soak (ISSUE 20) adds two EXACT keys:
+  ``chaos_recovery_time_ms`` gates on INCREASE with the latency floor
+  (the recovery-time SLO — kill-to-first-executed wall time), and
+  ``chaos_recovery_goodput_per_sec`` (whole-run goodput INCLUDING the
+  outage window) gates on DROP like any throughput headline.  Exact
+  matches, so no unrelated future ``*_time_ms`` key leaks into the gate.
 - A key regresses when its drop exceeds BOTH noise defenses:
   ``drop > max(sigmas * sqrt(base_std² + cand_std²),
   rel_floor * base_mean)`` — the stddev band covers measured run-to-run
@@ -74,6 +80,14 @@ _LOAD_P99_SUFFIX = "_p99_ms"
 # with unresolved requests charged their age-so-far.  Gated on INCREASE
 # like the plain p99 (and matched FIRST — it also ends in "_p99_ms").
 _LOAD_FINALITY_SUFFIX = "_finality_p99_ms"
+# Crash-recovery soak headlines (ISSUE 20, perf/CHAOS.md §recovery):
+# EXACT key matches, not suffix rules — the recovery phase emits exactly
+# these two, and an exact match can never pull an unrelated future
+# ``*_time_ms`` key into the gate.  Recovery time gates on INCREASE with
+# the latency floor (kill-to-first-executed wall time is single-run and
+# jittery); under-recovery goodput gates on DROP like any throughput.
+_RECOVERY_TIME_KEY = "chaos_recovery_time_ms"
+_RECOVERY_GOODPUT_KEY = "chaos_recovery_goodput_per_sec"
 
 
 def _in_load_namespace(key: str) -> bool:
@@ -158,6 +172,11 @@ def gated_pairs(
         ):
             prefix = key[: -len("_ms")]
             direction = "increase"
+        elif key == _RECOVERY_TIME_KEY:
+            prefix = key[: -len("_ms")]
+            direction = "increase"
+        elif key == _RECOVERY_GOODPUT_KEY:
+            prefix = key[: -len("_per_sec")]
         else:
             continue
         if key in candidate:
